@@ -1,0 +1,26 @@
+"""elasticsearch_tpu — a TPU-native distributed search engine.
+
+A ground-up JAX/XLA/Pallas implementation of the capabilities of Elasticsearch
+(reference: lastlearner/elasticsearch, ES 8.0.0-SNAPSHOT on Lucene 8.8.0). The
+host side keeps Elasticsearch's proven distributed shapes — immutable segments,
+translog + seqno checkpoints, scatter-gather query-then-fetch, a typed settings
+registry, and the REST surface — while the per-shard query executor (the hot
+loop at reference search/internal/ContextIndexSearcher.java:213) is re-designed
+as batched device programs over HBM-resident block-compressed segment arrays.
+
+Package layout (reference layer map, SURVEY.md §1):
+  common/     Settings, circuit breakers, errors   (ref: server common/, layer 2)
+  analysis/   analyzers & token filters            (ref: index/analysis, analysis-common)
+  mapper/     field types, document parsing        (ref: index/mapper)
+  index/      segments, translog, engine, shard    (ref: index/engine, index/translog)
+  ops/        JAX/Pallas device kernels            (ref: Lucene postings/BM25/top-k read path)
+  search/     query DSL, query & fetch phases      (ref: index/query, search/)
+  parallel/   device mesh sharding & collectives   (ref: scatter-gather fan-out, §2.10)
+  cluster/    cluster state, coordination          (ref: cluster/)
+  transport/  action registry RPC                  (ref: transport/, action/)
+  rest/       HTTP REST frontend                   (ref: rest/, http/)
+  models/     flagship scoring models (BM25/kNN/hybrid programs)
+  utils/      small shared helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,23 @@
+from elasticsearch_tpu.analysis.analyzers import (
+    Analyzer,
+    AnalysisRegistry,
+    Token,
+    StandardAnalyzer,
+    WhitespaceAnalyzer,
+    KeywordAnalyzer,
+    SimpleAnalyzer,
+    StopAnalyzer,
+    ENGLISH_STOPWORDS,
+)
+
+__all__ = [
+    "Analyzer",
+    "AnalysisRegistry",
+    "Token",
+    "StandardAnalyzer",
+    "WhitespaceAnalyzer",
+    "KeywordAnalyzer",
+    "SimpleAnalyzer",
+    "StopAnalyzer",
+    "ENGLISH_STOPWORDS",
+]

@@ -1,0 +1,28 @@
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuError,
+    IndexNotFoundError,
+    DocumentMissingError,
+    VersionConflictError,
+    CircuitBreakingError,
+    IllegalArgumentError,
+    ParsingError,
+    ResourceAlreadyExistsError,
+)
+from elasticsearch_tpu.common.settings import Setting, Settings, ClusterSettings
+from elasticsearch_tpu.common.breaker import CircuitBreaker, HierarchyCircuitBreakerService
+
+__all__ = [
+    "ElasticsearchTpuError",
+    "IndexNotFoundError",
+    "DocumentMissingError",
+    "VersionConflictError",
+    "CircuitBreakingError",
+    "IllegalArgumentError",
+    "ParsingError",
+    "ResourceAlreadyExistsError",
+    "Setting",
+    "Settings",
+    "ClusterSettings",
+    "CircuitBreaker",
+    "HierarchyCircuitBreakerService",
+]

@@ -1,0 +1,82 @@
+"""Exception hierarchy mirroring the reference's ElasticsearchException family.
+
+Each error carries an HTTP status so the REST layer can map exceptions to
+responses the way the reference does (ref: ElasticsearchException.status()).
+"""
+
+from __future__ import annotations
+
+
+class ElasticsearchTpuError(Exception):
+    """Base error; subclasses set `status` for REST mapping."""
+
+    status = 500
+    error_type = "exception"
+
+    def __init__(self, message: str, **metadata):
+        super().__init__(message)
+        self.message = message
+        self.metadata = metadata
+
+    def to_dict(self) -> dict:
+        out = {"type": self.error_type, "reason": self.message}
+        out.update(self.metadata)
+        return out
+
+
+class IndexNotFoundError(ElasticsearchTpuError):
+    status = 404
+    error_type = "index_not_found_exception"
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]", index=index)
+        self.index = index
+
+
+class ResourceAlreadyExistsError(ElasticsearchTpuError):
+    status = 400
+    error_type = "resource_already_exists_exception"
+
+
+class DocumentMissingError(ElasticsearchTpuError):
+    status = 404
+    error_type = "document_missing_exception"
+
+
+class VersionConflictError(ElasticsearchTpuError):
+    """Optimistic-concurrency failure (ref: VersionConflictEngineException)."""
+
+    status = 409
+    error_type = "version_conflict_engine_exception"
+
+
+class CircuitBreakingError(ElasticsearchTpuError):
+    """Memory limit trip (ref: common/breaker/CircuitBreakingException.java)."""
+
+    status = 429
+    error_type = "circuit_breaking_exception"
+
+
+class IllegalArgumentError(ElasticsearchTpuError):
+    status = 400
+    error_type = "illegal_argument_exception"
+
+
+class ParsingError(ElasticsearchTpuError):
+    status = 400
+    error_type = "parsing_exception"
+
+
+class MapperParsingError(ElasticsearchTpuError):
+    status = 400
+    error_type = "mapper_parsing_exception"
+
+
+class SearchPhaseExecutionError(ElasticsearchTpuError):
+    status = 500
+    error_type = "search_phase_execution_exception"
+
+
+class ShardNotFoundError(ElasticsearchTpuError):
+    status = 404
+    error_type = "shard_not_found_exception"

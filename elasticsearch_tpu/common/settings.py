@@ -1,0 +1,247 @@
+"""Typed, validated, dynamically-updatable settings.
+
+Re-designs the reference's Setting/Settings/ClusterSettings trio
+(ref: common/settings/Setting.java, ClusterSettings.java,
+IndexScopedSettings.java) as plain Python: a `Setting` is a typed key with a
+default, parser, validator, scope and a `dynamic` flag; `Settings` is an
+immutable key->raw-value map with typed reads; `ClusterSettings` is the
+registry that validates updates and notifies subscribers on dynamic changes.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Generic, Iterable, Mapping, TypeVar
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+T = TypeVar("T")
+
+_TIME_RE = re.compile(r"^(-?\d+(?:\.\d+)?)(nanos|micros|ms|s|m|h|d)$")
+_BYTES_RE = re.compile(r"^(-?\d+(?:\.\d+)?)(b|kb|mb|gb|tb|pb)?$", re.IGNORECASE)
+
+_TIME_FACTORS = {"nanos": 1e-9, "micros": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+_BYTE_FACTORS = {None: 1, "b": 1, "kb": 1 << 10, "mb": 1 << 20, "gb": 1 << 30, "tb": 1 << 40, "pb": 1 << 50}
+
+
+def parse_time_value(value: Any) -> float:
+    """'30s' / '500ms' / number -> seconds."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _TIME_RE.match(str(value).strip())
+    if not m:
+        raise IllegalArgumentError(f"failed to parse time value [{value}]")
+    return float(m.group(1)) * _TIME_FACTORS[m.group(2)]
+
+
+def parse_bytes_value(value: Any) -> int:
+    """'512mb' / '1gb' / number -> bytes."""
+    if isinstance(value, (int, float)):
+        return int(value)
+    m = _BYTES_RE.match(str(value).strip())
+    if not m:
+        raise IllegalArgumentError(f"failed to parse byte size value [{value}]")
+    unit = m.group(2).lower() if m.group(2) else None
+    return int(float(m.group(1)) * _BYTE_FACTORS[unit])
+
+
+def _parse_bool(value: Any) -> bool:
+    if isinstance(value, bool):
+        return value
+    s = str(value).strip().lower()
+    if s == "true":
+        return True
+    if s == "false":
+        return False
+    raise IllegalArgumentError(f"failed to parse boolean value [{value}], expected [true] or [false]")
+
+
+class Setting(Generic[T]):
+    """A typed setting key. Scope is 'node', 'cluster' or 'index'."""
+
+    def __init__(
+        self,
+        key: str,
+        default: T | Callable[["Settings"], T],
+        parser: Callable[[Any], T],
+        *,
+        scope: str = "cluster",
+        dynamic: bool = False,
+        validator: Callable[[T], None] | None = None,
+    ):
+        self.key = key
+        self._default = default
+        self.parser = parser
+        self.scope = scope
+        self.dynamic = dynamic
+        self.validator = validator
+
+    def default(self, settings: "Settings") -> T:
+        if callable(self._default):
+            return self._default(settings)
+        return self._default
+
+    def get(self, settings: "Settings") -> T:
+        raw = settings.raw(self.key)
+        if raw is None:
+            return self.default(settings)
+        value = self.parser(raw)
+        if self.validator is not None:
+            self.validator(value)
+        return value
+
+    # -- constructors mirroring the reference's factory methods --
+
+    @staticmethod
+    def bool_setting(key: str, default: bool, **kw) -> "Setting[bool]":
+        return Setting(key, default, _parse_bool, **kw)
+
+    @staticmethod
+    def int_setting(key: str, default: int, min_value: int | None = None, **kw) -> "Setting[int]":
+        def parse(v):
+            i = int(v)
+            if min_value is not None and i < min_value:
+                raise IllegalArgumentError(f"failed to parse value [{v}] for setting [{key}] must be >= {min_value}")
+            return i
+
+        return Setting(key, default, parse, **kw)
+
+    @staticmethod
+    def float_setting(key: str, default: float, **kw) -> "Setting[float]":
+        return Setting(key, default, float, **kw)
+
+    @staticmethod
+    def str_setting(key: str, default: str, **kw) -> "Setting[str]":
+        return Setting(key, default, str, **kw)
+
+    @staticmethod
+    def time_setting(key: str, default: float | str, **kw) -> "Setting[float]":
+        dflt = parse_time_value(default) if isinstance(default, str) else default
+        return Setting(key, dflt, parse_time_value, **kw)
+
+    @staticmethod
+    def bytes_setting(key: str, default: int | str, **kw) -> "Setting[int]":
+        dflt = parse_bytes_value(default) if isinstance(default, str) else default
+        return Setting(key, dflt, parse_bytes_value, **kw)
+
+
+class Settings(Mapping[str, Any]):
+    """Immutable flat key->value map. Nested dicts are flattened with dots."""
+
+    def __init__(self, values: Mapping[str, Any] | None = None):
+        self._values: dict[str, Any] = {}
+        if values:
+            self._flatten("", values)
+
+    def _flatten(self, prefix: str, values: Mapping[str, Any]) -> None:
+        for k, v in values.items():
+            key = f"{prefix}{k}"
+            if isinstance(v, Mapping):
+                self._flatten(f"{key}.", v)
+            else:
+                self._values[key] = v
+
+    EMPTY: "Settings"
+
+    def raw(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def get(self, setting: "Setting[T] | str", default: Any = None) -> Any:
+        if isinstance(setting, Setting):
+            return setting.get(self)
+        return self._values.get(setting, default)
+
+    def with_updates(self, updates: Mapping[str, Any]) -> "Settings":
+        merged = dict(self._values)
+        flat = Settings(updates)
+        for k, v in flat._values.items():
+            if v is None:
+                merged.pop(k, None)  # null value resets to default, as in the reference API
+            else:
+                merged[k] = v
+        out = Settings()
+        out._values = merged
+        return out
+
+    def filtered_by_prefix(self, prefix: str) -> "Settings":
+        out = Settings()
+        out._values = {k: v for k, v in self._values.items() if k.startswith(prefix)}
+        return out
+
+    def as_dict(self) -> dict[str, Any]:
+        return dict(self._values)
+
+    def as_nested_dict(self) -> dict[str, Any]:
+        nested: dict[str, Any] = {}
+        for key, value in sorted(self._values.items()):
+            parts = key.split(".")
+            node = nested
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+                if not isinstance(node, dict):
+                    break
+            else:
+                node[parts[-1]] = value
+        return nested
+
+    def __getitem__(self, key: str) -> Any:
+        return self._values[key]
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def __repr__(self) -> str:
+        return f"Settings({self._values!r})"
+
+
+Settings.EMPTY = Settings()
+
+
+class ClusterSettings:
+    """Registry of known settings + dynamic-update subscription.
+
+    Ref: common/settings/AbstractScopedSettings.java — validates that updates
+    only touch registered dynamic settings and notifies consumers.
+    """
+
+    def __init__(self, initial: Settings, registered: Iterable[Setting] | None = None):
+        self._settings = initial
+        self._registered: dict[str, Setting] = {}
+        self._consumers: list[tuple[Setting, Callable[[Any], None]]] = []
+        for s in registered or ():
+            self.register(s)
+
+    def register(self, setting: Setting) -> None:
+        self._registered[setting.key] = setting
+
+    @property
+    def settings(self) -> Settings:
+        return self._settings
+
+    def get(self, setting: Setting[T]) -> T:
+        return setting.get(self._settings)
+
+    def add_settings_update_consumer(self, setting: Setting[T], consumer: Callable[[T], None]) -> None:
+        self._consumers.append((setting, consumer))
+
+    def apply(self, updates: Mapping[str, Any]) -> Settings:
+        """Validate + apply updates; notify consumers whose value changed."""
+        flat = Settings(updates)
+        for key in flat:
+            reg = self._registered.get(key)
+            if reg is None:
+                raise IllegalArgumentError(f"transient setting [{key}], not recognized")
+            if not reg.dynamic:
+                raise IllegalArgumentError(f"final {reg.scope} setting [{key}], not updateable")
+            if flat.raw(key) is not None:
+                reg.parser(flat.raw(key))  # validate before committing
+        old = self._settings
+        self._settings = old.with_updates(updates)
+        for setting, consumer in self._consumers:
+            new_val = setting.get(self._settings)
+            if setting.get(old) != new_val:
+                consumer(new_val)
+        return self._settings

@@ -1,0 +1,25 @@
+from elasticsearch_tpu.mapper.field_types import (
+    FieldType,
+    TextFieldType,
+    KeywordFieldType,
+    NumberFieldType,
+    DateFieldType,
+    BooleanFieldType,
+    DenseVectorFieldType,
+    build_field_type,
+)
+from elasticsearch_tpu.mapper.mapper_service import MapperService, ParsedDocument, LuceneDoc
+
+__all__ = [
+    "FieldType",
+    "TextFieldType",
+    "KeywordFieldType",
+    "NumberFieldType",
+    "DateFieldType",
+    "BooleanFieldType",
+    "DenseVectorFieldType",
+    "build_field_type",
+    "MapperService",
+    "ParsedDocument",
+    "LuceneDoc",
+]

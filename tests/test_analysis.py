@@ -1,0 +1,56 @@
+from elasticsearch_tpu.analysis import (
+    AnalysisRegistry,
+    KeywordAnalyzer,
+    SimpleAnalyzer,
+    StandardAnalyzer,
+    StopAnalyzer,
+    WhitespaceAnalyzer,
+)
+
+
+def test_standard_analyzer_lowercases_and_splits():
+    a = StandardAnalyzer()
+    assert a.terms("The QUICK Brown-Fox, jumps!") == ["the", "quick", "brown", "fox", "jumps"]
+
+
+def test_standard_analyzer_positions_and_offsets():
+    a = StandardAnalyzer()
+    toks = a.tokenize("Hello, World")
+    assert [(t.term, t.position) for t in toks] == [("hello", 0), ("world", 1)]
+    assert (toks[1].start_offset, toks[1].end_offset) == (7, 12)
+
+
+def test_whitespace_analyzer_preserves_case():
+    assert WhitespaceAnalyzer().terms("Foo BAR baz") == ["Foo", "BAR", "baz"]
+
+
+def test_keyword_analyzer_single_token():
+    assert KeywordAnalyzer().terms("New York City") == ["New York City"]
+    assert KeywordAnalyzer().terms("") == []
+
+
+def test_simple_analyzer_letters_only():
+    assert SimpleAnalyzer().terms("abc123def") == ["abc", "def"]
+
+
+def test_stop_analyzer_removes_stopwords():
+    assert StopAnalyzer().terms("the quick fox") == ["quick", "fox"]
+
+
+def test_numbers_tokenized_by_standard():
+    assert StandardAnalyzer().terms("ipv4 10.0.0.1 port 9200") == ["ipv4", "10", "0", "0", "1", "port", "9200"]
+
+
+def test_registry_builtin_and_custom():
+    reg = AnalysisRegistry({
+        "my_custom": {"tokenizer": "whitespace", "filter": ["lowercase"]},
+        "folded": {"tokenizer": "standard", "filter": ["lowercase", "asciifolding"]},
+    })
+    assert reg.get("standard").terms("A b") == ["a", "b"]
+    assert reg.get("my_custom").terms("Foo-Bar BAZ") == ["foo-bar", "baz"]
+    assert reg.get("folded").terms("Café Über") == ["cafe", "uber"]
+
+
+def test_unicode_text():
+    a = StandardAnalyzer()
+    assert a.terms("Москва 北京 café") == ["москва", "北京", "café"]

@@ -1,0 +1,86 @@
+import pytest
+
+from elasticsearch_tpu.common import (
+    CircuitBreaker,
+    CircuitBreakingError,
+    ClusterSettings,
+    HierarchyCircuitBreakerService,
+    IllegalArgumentError,
+    Setting,
+    Settings,
+)
+from elasticsearch_tpu.common.settings import parse_bytes_value, parse_time_value
+
+
+def test_settings_flatten_and_nested_roundtrip():
+    s = Settings({"index": {"number_of_shards": 4, "refresh_interval": "1s"}, "cluster.name": "x"})
+    assert s.raw("index.number_of_shards") == 4
+    assert s.raw("cluster.name") == "x"
+    nested = s.as_nested_dict()
+    assert nested["index"]["number_of_shards"] == 4
+
+
+def test_settings_updates_and_null_reset():
+    s = Settings({"a.b": 1, "a.c": 2})
+    s2 = s.with_updates({"a.b": 5, "a.c": None})
+    assert s2.raw("a.b") == 5
+    assert s2.raw("a.c") is None
+    assert s.raw("a.b") == 1  # immutable
+
+
+def test_typed_settings():
+    num_shards = Setting.int_setting("index.number_of_shards", 1, min_value=1, scope="index")
+    refresh = Setting.time_setting("index.refresh_interval", "1s", dynamic=True)
+    s = Settings({"index.number_of_shards": "4"})
+    assert num_shards.get(s) == 4
+    assert refresh.get(s) == 1.0
+    assert refresh.get(Settings({"index.refresh_interval": "500ms"})) == 0.5
+
+
+def test_time_and_bytes_parsing():
+    assert parse_time_value("30s") == 30.0
+    assert parse_time_value("2m") == 120.0
+    assert parse_time_value("100ms") == 0.1
+    assert parse_bytes_value("1kb") == 1024
+    assert parse_bytes_value("2gb") == 2 << 30
+    with pytest.raises(IllegalArgumentError):
+        parse_time_value("abc")
+
+
+def test_cluster_settings_dynamic_update_and_consumer():
+    refresh = Setting.time_setting("index.refresh_interval", "1s", dynamic=True)
+    static = Setting.int_setting("node.processors", 4)
+    cs = ClusterSettings(Settings(), [refresh, static])
+    seen = []
+    cs.add_settings_update_consumer(refresh, seen.append)
+    cs.apply({"index.refresh_interval": "5s"})
+    assert seen == [5.0]
+    with pytest.raises(IllegalArgumentError):
+        cs.apply({"node.processors": 8})  # not dynamic
+    with pytest.raises(IllegalArgumentError):
+        cs.apply({"nope.unknown": 1})  # unregistered
+
+
+def test_circuit_breaker_trips_and_releases():
+    b = CircuitBreaker("request", limit_bytes=1000)
+    b.add_estimate_bytes_and_maybe_break(800, "agg")
+    with pytest.raises(CircuitBreakingError):
+        b.add_estimate_bytes_and_maybe_break(300, "agg2")
+    assert b.used_bytes == 800
+    assert b.trip_count == 1
+    b.release(800)
+    assert b.used_bytes == 0
+    b.add_estimate_bytes_and_maybe_break(900, "ok")
+
+
+def test_hierarchy_breaker_parent_enforced():
+    svc = HierarchyCircuitBreakerService(total_limit_bytes=1000)
+    req = svc.get_breaker("request")
+    fd = svc.get_breaker("fielddata")
+    req.add_estimate_bytes_and_maybe_break(500, "r")
+    with pytest.raises(CircuitBreakingError):
+        fd.add_estimate_bytes_and_maybe_break(390, "f")  # fielddata limit 400, overhead 1.03
+    # parent trips even when the child alone would allow it
+    with pytest.raises(CircuitBreakingError):
+        req.add_estimate_bytes_and_maybe_break(501, "r2")
+    assert svc.get_breaker("request").used_bytes == 500

@@ -1,0 +1,116 @@
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.mapper.field_types import MapperParsingError, parse_date_millis
+
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "tags": {"type": "keyword"},
+        "views": {"type": "long"},
+        "rating": {"type": "double"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+        "embedding": {"type": "dense_vector", "dims": 4},
+        "author": {"properties": {"name": {"type": "keyword"}, "age": {"type": "integer"}}},
+    }
+}
+
+
+def make_service():
+    return MapperService(MAPPING)
+
+
+def test_mapping_roundtrip():
+    svc = make_service()
+    m = svc.mapping()["properties"]
+    assert m["title"]["type"] == "text"
+    assert m["author"]["properties"]["name"]["type"] == "keyword"
+    assert m["embedding"]["dims"] == 4
+
+
+def test_parse_text_terms_and_lengths():
+    svc = make_service()
+    doc = svc.parse("1", {"title": "The quick brown fox the fox"})
+    terms = dict(doc.inverted["title"])
+    assert terms["fox"] == [3, 5]
+    assert terms["the"] == [0, 4]
+    assert doc.field_lengths["title"] == 6
+
+
+def test_parse_multivalue_text_position_gap():
+    svc = make_service()
+    doc = svc.parse("1", {"title": ["foo bar", "baz"]})
+    terms = dict(doc.inverted["title"])
+    assert terms["foo"] == [0]
+    assert terms["bar"] == [1]
+    assert terms["baz"][0] >= 100  # position gap across values
+    assert doc.field_lengths["title"] == 3  # gap does not inflate norm
+
+
+def test_parse_numeric_date_bool_keyword_vector():
+    svc = make_service()
+    doc = svc.parse("1", {
+        "views": 42,
+        "rating": 4.5,
+        "published": "2021-06-01T12:00:00Z",
+        "active": True,
+        "tags": ["a", "b"],
+        "embedding": [1, 2, 3, 4],
+        "author": {"name": "kimchy", "age": 40},
+    })
+    assert doc.numeric["views"] == [42.0]
+    assert doc.numeric["rating"] == [4.5]
+    assert doc.numeric["published"] == [float(parse_date_millis("2021-06-01T12:00:00Z"))]
+    assert doc.numeric["active"] == [1.0]
+    assert doc.keyword["tags"] == ["a", "b"]
+    assert doc.keyword["author.name"] == ["kimchy"]
+    assert doc.numeric["author.age"] == [40.0]
+    np.testing.assert_array_equal(doc.vectors["embedding"], np.array([1, 2, 3, 4], np.float32))
+
+
+def test_numeric_range_validation():
+    svc = MapperService({"properties": {"n": {"type": "byte"}}})
+    with pytest.raises(MapperParsingError):
+        svc.parse("1", {"n": 1000})
+
+
+def test_vector_dims_validation():
+    svc = make_service()
+    with pytest.raises(MapperParsingError):
+        svc.parse("1", {"embedding": [1, 2, 3]})
+
+
+def test_dynamic_mapping():
+    svc = MapperService()
+    doc = svc.parse("1", {"name": "hello world", "count": 3, "score": 1.5,
+                          "flag": False, "when": "2020-01-01"})
+    assert svc.field_type("name").params["type"] == "text"
+    assert svc.field_type("name.keyword").params["type"] == "keyword"
+    assert svc.field_type("count").params["type"] == "long"
+    assert svc.field_type("score").params["type"] == "float"
+    assert svc.field_type("flag").params["type"] == "boolean"
+    assert svc.field_type("when").params["type"] == "date"
+    assert dict(doc.inverted["name"])["hello"] == [0]
+    assert doc.keyword["name.keyword"] == ["hello world"]
+
+
+def test_merge_conflict_rejected():
+    svc = make_service()
+    with pytest.raises(IllegalArgumentError):
+        svc.merge({"properties": {"title": {"type": "keyword"}}})
+    # adding a new field is fine
+    svc.merge({"properties": {"body": {"type": "text"}}})
+    assert svc.field_type("body") is not None
+
+
+def test_date_parsing_formats():
+    assert parse_date_millis(0) == 0
+    assert parse_date_millis("1577836800000") == 1577836800000
+    assert parse_date_millis("2020-01-01") == 1577836800000
+    assert parse_date_millis("2020-01-01T00:00:00Z") == 1577836800000
+    with pytest.raises(MapperParsingError):
+        parse_date_millis("not-a-date")
