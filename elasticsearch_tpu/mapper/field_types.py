@@ -37,6 +37,8 @@ class FieldType:
     def __init__(self, name: str, params: dict):
         self.name = name
         self.params = params
+        # sub-fields indexed from the same JSON value (mapping "fields": {...})
+        self.multi_fields: list["FieldType"] = []
 
     # inverted-index terms for one JSON value: list of (term, [positions])
     def index_terms(self, value: Any, analyzer=None) -> List[Tuple[str, List[int]]]:
@@ -49,8 +51,12 @@ class FieldType:
     def mapping(self) -> dict:
         out = {"type": self.params.get("type", "object")}
         for k, v in self.params.items():
-            if k != "type":
+            if k not in ("type", "fields"):
                 out[k] = v
+        if self.multi_fields:
+            out["fields"] = {
+                mf.name.rsplit(".", 1)[1]: mf.mapping() for mf in self.multi_fields
+            }
         return out
 
 

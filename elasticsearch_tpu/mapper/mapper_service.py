@@ -98,6 +98,10 @@ class MapperService:
                         f"[{existing.params.get('type')}] to [{definition.get('type')}]"
                     )
                 continue
+            for sub_name, sub_def in (definition.get("fields") or {}).items():
+                sub = build_field_type(f"{full}.{sub_name}", sub_def)
+                new_type.multi_fields.append(sub)
+                self._field_types[f"{full}.{sub_name}"] = sub
             self._field_types[full] = new_type
 
     def field_type(self, name: str) -> FieldType | None:
@@ -158,6 +162,8 @@ class MapperService:
             self._index_values(ft, values, doc)
 
     def _index_values(self, ft: FieldType, values: list, doc: LuceneDoc) -> None:
+        for mf in ft.multi_fields:
+            self._index_values(mf, values, doc)
         for v in values:
             if v is None:
                 continue
@@ -205,12 +211,14 @@ class MapperService:
                 params = {"type": "date"}
             else:
                 params = {"type": "text"}
-                kw = build_field_type(f"{name}.keyword", {"type": "keyword", "ignore_above": 256})
-                dyn[f"{name}.keyword"] = kw
-                self._field_types.setdefault(f"{name}.keyword", kw)
         else:
             return None
         ft = build_field_type(name, params)
+        if params["type"] == "text":
+            kw = build_field_type(f"{name}.keyword", {"type": "keyword", "ignore_above": 256})
+            ft.multi_fields.append(kw)
+            dyn[f"{name}.keyword"] = kw
+            self._field_types.setdefault(f"{name}.keyword", kw)
         dyn[name] = ft
         self._field_types.setdefault(name, ft)
         return ft
