@@ -1,0 +1,3 @@
+from elasticsearch_tpu.index.segment import FieldPostings, Segment, SegmentBuilder, BLOCK
+
+__all__ = ["FieldPostings", "Segment", "SegmentBuilder", "BLOCK"]

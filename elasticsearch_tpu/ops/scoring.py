@@ -1,0 +1,120 @@
+"""Device scoring ops: blocked BM25 scatter-scoring and masked top-k.
+
+This is the TPU-native replacement for the reference's per-segment hot loop —
+Lucene postings decode + BM25 + heap collection driven from
+ContextIndexSearcher (ref: search/internal/ContextIndexSearcher.java:213-216,
+Lucene BM25Similarity). Instead of a branchy doc-at-a-time WAND iterator, we
+score whole 128-lane postings blocks data-parallel:
+
+    gather selected blocks from HBM  ->  vectorized BM25 over [B, 128] lanes
+    ->  scatter-add into a dense per-doc score vector  ->  lax.top_k
+
+Conventions that keep everything branch-free under jit:
+  * Every segment reserves block row 0 as an all-zero block (doc 0, tf 0);
+    padding a query's block-id list with 0 adds exactly 0.0 to doc 0.
+  * Block-id lists are padded to power-of-two buckets so XLA compiles one
+    program per bucket size, not per query.
+  * tf == 0 lanes contribute 0 score by construction of the BM25 formula.
+
+All functions are jit-compiled and cached by shape.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK = 128
+
+
+def bm25_idf(doc_count: int, doc_freq: int) -> float:
+    """Lucene BM25 idf: ln(1 + (N - df + 0.5) / (df + 0.5))."""
+    return math.log(1.0 + (doc_count - doc_freq + 0.5) / (doc_freq + 0.5))
+
+
+def next_bucket(n: int, minimum: int = 8) -> int:
+    """Round up to the next power of two to bound jit recompiles."""
+    if n <= minimum:
+        return minimum
+    return 1 << (n - 1).bit_length()
+
+
+def pad_block_ids(block_ids: np.ndarray, bucket: int | None = None) -> np.ndarray:
+    """Pad a host block-id list with the reserved zero block (row 0)."""
+    n = len(block_ids)
+    b = bucket or next_bucket(n)
+    out = np.zeros(b, dtype=np.int32)
+    out[:n] = block_ids
+    return out
+
+
+@partial(jax.jit, static_argnames=("n_docs", "k1", "b"))
+def bm25_scatter_scores(
+    block_docs: jax.Array,   # [T, 128] i32 — all postings blocks of the field
+    block_tfs: jax.Array,    # [T, 128] f32
+    doc_len: jax.Array,      # [n_docs] f32 — field length norms
+    block_ids: jax.Array,    # [B] i32 — selected block rows (padded with 0)
+    idf: jax.Array,          # [B] f32 — per-block idf weight of the owning term
+    avgdl: jax.Array,        # scalar f32
+    *,
+    n_docs: int,
+    k1: float = 1.2,
+    b: float = 0.75,
+) -> jax.Array:
+    """Score selected postings blocks, scatter-add into a dense [n_docs] f32.
+
+    BM25: idf * tf * (k1 + 1) / (tf + k1 * (1 - b + b * dl / avgdl))
+    (ref: Lucene 8 BM25Similarity with norms; boost folded into idf upstream)
+    """
+    docs = jnp.take(block_docs, block_ids, axis=0)           # [B, 128]
+    tfs = jnp.take(block_tfs, block_ids, axis=0)             # [B, 128]
+    dl = jnp.take(doc_len, docs, axis=0)                     # [B, 128] (doc 0 pad ok)
+    denom = tfs + k1 * (1.0 - b + b * dl / avgdl)
+    # guard tf==0 pad lanes: denom>0 always (k1*(1-b)>0), score becomes 0 via tf
+    scores = idf[:, None] * tfs * (k1 + 1.0) / denom
+    return jnp.zeros((n_docs,), jnp.float32).at[docs.ravel()].add(scores.ravel())
+
+
+@partial(jax.jit, static_argnames=("n_docs",))
+def constant_scatter_mask(
+    block_docs: jax.Array,   # [T, 128] i32
+    block_tfs: jax.Array,    # [T, 128] f32 (tf>0 marks real postings)
+    block_ids: jax.Array,    # [B] i32 (padded with 0)
+    *,
+    n_docs: int,
+) -> jax.Array:
+    """Boolean [n_docs] mask of docs present in the selected blocks.
+
+    Used for filter-context term/terms matching (constant score): the lane is
+    real iff its tf > 0, which also neutralizes both zero-block padding and
+    in-block tail padding.
+    """
+    docs = jnp.take(block_docs, block_ids, axis=0)
+    tfs = jnp.take(block_tfs, block_ids, axis=0)
+    hits = jnp.zeros((n_docs,), jnp.float32).at[docs.ravel()].add((tfs > 0).astype(jnp.float32).ravel())
+    return hits > 0
+
+
+@partial(jax.jit, static_argnames=("k",))
+def masked_top_k(scores: jax.Array, mask: jax.Array, *, k: int):
+    """Top-k by score over docs where mask is true.
+
+    Ties break by ascending doc ordinal, matching Lucene's collector
+    (ref: Lucene TopScoreDocCollector doc-id tie-break): implemented by
+    sorting on (score, -ord) packed comparisons via a tiny ordinal epsilon on
+    equal float scores is unsafe; instead we rely on lax.top_k which returns
+    the smallest index among equals, giving the same order.
+    """
+    masked = jnp.where(mask, scores, -jnp.inf)
+    top_scores, top_ords = jax.lax.top_k(masked, k)
+    valid = top_scores > -jnp.inf
+    return top_scores, top_ords, valid
+
+
+@jax.jit
+def total_hits(mask: jax.Array) -> jax.Array:
+    return jnp.sum(mask.astype(jnp.int32))

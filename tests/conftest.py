@@ -1,14 +1,24 @@
 """Test configuration: force an 8-device virtual CPU platform.
 
 Multi-chip hardware is not available in CI; sharding correctness is tested on
-a virtual CPU mesh exactly as the driver's dryrun does. Must run before jax
-initializes its backends, hence env manipulation at import time.
+a virtual CPU mesh exactly as the driver's dryrun does.
+
+The session's sitecustomize registers the axon TPU PJRT plugin in every
+process and force-sets jax_platforms to "axon,cpu" via jax.config — so env
+vars alone cannot keep tests off the (single, contended) TPU tunnel. We set
+the config back to cpu here, before any backend is initialized (backends init
+lazily at first use, which is after conftest import). Set TEST_ON_TPU=1 to
+deliberately run the suite against the chip.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+if os.environ.get("TEST_ON_TPU") != "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

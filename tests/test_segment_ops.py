@@ -1,0 +1,194 @@
+"""Segment build + device BM25 scoring parity vs a naive host reference."""
+
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import BLOCK, SegmentBuilder
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.ops import (
+    bm25_idf,
+    bm25_scatter_scores,
+    constant_scatter_mask,
+    knn_top_k,
+    masked_top_k,
+    pad_block_ids,
+)
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "quick quick quick repetition of quick terms",
+    "a completely unrelated document about jax and tpus",
+    "the lazy dog sleeps all day the dog dreams",
+    "fox hunting was banned in the united kingdom",
+    "tpus accelerate matrix multiplication for search engines",
+]
+
+
+def build_segment(texts=DOCS, extra=None):
+    svc = MapperService({"properties": {"body": {"type": "text"},
+                                        "tag": {"type": "keyword"},
+                                        "n": {"type": "long"},
+                                        "v": {"type": "dense_vector", "dims": 8}}})
+    b = SegmentBuilder()
+    for i, t in enumerate(texts):
+        src = {"body": t}
+        if extra:
+            src.update(extra[i])
+        b.add(svc.parse(str(i), src), seq_no=i)
+    return svc, b.build()
+
+
+def naive_bm25(texts, query_terms, k1=1.2, b=0.75):
+    """Reference scorer: classic Lucene BM25 over whitespace/lowercase terms."""
+    tokenized = [t.lower().replace(",", "").split() for t in texts]
+    n = len(texts)
+    avgdl = sum(len(d) for d in tokenized) / n
+    scores = np.zeros(n)
+    for term in query_terms:
+        df = sum(1 for d in tokenized if term in d)
+        if df == 0:
+            continue
+        idf = math.log(1 + (n - df + 0.5) / (df + 0.5))
+        for i, d in enumerate(tokenized):
+            tf = d.count(term)
+            if tf:
+                scores[i] += idf * tf * (k1 + 1) / (tf + k1 * (1 - b + b * len(d) / avgdl))
+    return scores
+
+
+def device_scores_for_terms(seg, field, terms, k1=1.2, b=0.75):
+    fp = seg.postings[field]
+    n_field_docs, sum_dl = seg.field_stats(field)
+    avgdl = sum_dl / max(n_field_docs, 1)
+    block_docs, block_tfs, doc_len = seg.device(f"post:{field}")
+    total = np.zeros(seg.n_docs, np.float32)
+    for term in terms:
+        ids = fp.term_block_ids(term)
+        if len(ids) == 0:
+            continue
+        df, _ = seg.term_stats(field, term)
+        idf = bm25_idf(seg.n_docs, df)
+        padded = pad_block_ids(ids)
+        idf_arr = np.zeros(len(padded), np.float32)
+        idf_arr[: len(ids)] = idf
+        s = bm25_scatter_scores(block_docs, block_tfs, doc_len, padded, idf_arr,
+                                np.float32(avgdl), n_docs=seg.n_docs, k1=k1, b=b)
+        total += np.asarray(s)
+    return total
+
+
+def test_block_layout_invariants():
+    _, seg = build_segment()
+    fp = seg.postings["body"]
+    assert np.all(fp.block_docs[0] == 0) and np.all(fp.block_tfs[0] == 0)
+    o = fp.term_to_ord["quick"]
+    assert fp.doc_freq[o] == 2
+    assert fp.total_term_freq[o] == 5  # 1 + 4
+    assert fp.block_docs.shape[1] == BLOCK
+    # doc lengths = token counts
+    assert fp.doc_len[0] == 9
+    assert seg.n_docs == len(DOCS)
+
+
+def test_bm25_parity_single_term():
+    _, seg = build_segment()
+    got = device_scores_for_terms(seg, "body", ["quick"])
+    want = naive_bm25(DOCS, ["quick"])
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_bm25_parity_multi_term():
+    _, seg = build_segment()
+    for terms in (["the", "dog"], ["quick", "fox", "tpus"], ["absent"], ["a", "of", "search"]):
+        got = device_scores_for_terms(seg, "body", terms)
+        want = naive_bm25(DOCS, terms)
+        np.testing.assert_allclose(got, want, rtol=1e-5, err_msg=str(terms))
+
+
+def test_bm25_parity_large_random_corpus():
+    rng = np.random.default_rng(42)
+    vocab = [f"w{i}" for i in range(50)]
+    # Zipf-ish sampling so some terms span multiple 128-doc blocks
+    probs = 1.0 / np.arange(1, len(vocab) + 1)
+    probs /= probs.sum()
+    texts = [" ".join(rng.choice(vocab, size=rng.integers(3, 30), p=probs)) for _ in range(700)]
+    _, seg = build_segment(texts)
+    fp = seg.postings["body"]
+    assert int(fp.block_count.max()) >= 2  # multi-block terms exercised
+    for terms in (["w0"], ["w0", "w7", "w33"], ["w1", "w2"]):
+        got = device_scores_for_terms(seg, "body", terms)
+        want = naive_bm25(texts, terms)
+        np.testing.assert_allclose(got, want, rtol=2e-4, err_msg=str(terms))
+
+
+def test_masked_top_k_order_and_validity():
+    _, seg = build_segment()
+    scores = device_scores_for_terms(seg, "body", ["the", "dog"])
+    import jax.numpy as jnp
+
+    mask = jnp.ones(seg.n_docs, bool)
+    top_s, top_o, valid = masked_top_k(jnp.asarray(scores), mask, k=3)
+    want = naive_bm25(DOCS, ["the", "dog"])
+    assert list(np.asarray(top_o)[:2]) == list(np.argsort(-want)[:2])
+    # mask out best doc
+    mask = mask.at[int(top_o[0])].set(False)
+    top_s2, top_o2, _ = masked_top_k(jnp.asarray(scores), mask, k=3)
+    assert int(top_o2[0]) == int(top_o[1])
+    # k > matches: invalid tail
+    only = device_scores_for_terms(seg, "body", ["kingdom"])
+    t, o, v = masked_top_k(jnp.asarray(only), jnp.asarray(only) > 0, k=5)
+    assert int(v.sum()) == 1
+
+
+def test_constant_mask_keyword_postings():
+    extra = [{"tag": ["red", "hot"]}, {"tag": "blue"}, {"tag": "red"}, {}, {"tag": "blue"}, {"tag": "green"}]
+    _, seg = build_segment(extra=extra)
+    fp = seg.postings["tag"]
+    block_docs, block_tfs, _ = seg.device("post:tag")
+    ids = pad_block_ids(fp.term_block_ids("red"))
+    mask = constant_scatter_mask(block_docs, block_tfs, ids, n_docs=seg.n_docs)
+    np.testing.assert_array_equal(np.asarray(mask), [True, False, True, False, False, False])
+    # multivalued: doc 0 also matches "hot"
+    ids = pad_block_ids(fp.term_block_ids("hot"))
+    mask = constant_scatter_mask(block_docs, block_tfs, ids, n_docs=seg.n_docs)
+    assert bool(mask[0]) and int(np.asarray(mask).sum()) == 1
+
+
+def test_numeric_column_and_range_mask():
+    extra = [{"n": 5}, {"n": [1, 10]}, {"n": 7}, {}, {"n": 3}, {"n": 10}]
+    _, seg = build_segment(extra=extra)
+    col = seg.numeric["n"]
+    np.testing.assert_array_equal(col.range_mask(4, 8, True, True),
+                                  [True, False, True, False, False, False])
+    # multivalue: doc 1 has values {1,10}; range 9..12 matches it and doc 5
+    np.testing.assert_array_equal(col.range_mask(9, 12, True, True),
+                                  [False, True, False, False, False, True])
+
+
+def test_positions_csr():
+    _, seg = build_segment()
+    fp = seg.postings["body"]
+    np.testing.assert_array_equal(fp.positions("the", 0), [0, 6])
+    np.testing.assert_array_equal(fp.positions("quick", 1), [0, 1, 2, 5])
+    assert len(fp.positions("quick", 3)) == 0
+
+
+def test_knn_top_k_cosine():
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(6, 8)).astype(np.float32)
+    extra = [{"v": vecs[i].tolist()} for i in range(6)]
+    _, seg = build_segment(extra=extra)
+    import jax.numpy as jnp
+
+    v, norms, exists = seg.device("v:v") if False else seg.device("vec:v")
+    q = vecs[2:3]
+    top_s, top_o, valid = knn_top_k(jnp.asarray(q), v, norms, exists,
+                                    jnp.ones(seg.n_docs, bool), similarity="cosine", k=3)
+    assert int(top_o[0, 0]) == 2  # self-similarity wins
+    assert float(top_s[0, 0]) == pytest.approx(1.0, abs=2e-2)  # (1+cos)/2, bf16 tolerance
+    # parity with numpy
+    cos = (vecs @ q[0]) / (np.linalg.norm(vecs, axis=1) * np.linalg.norm(q[0]))
+    want_order = np.argsort(-cos)[:3]
+    np.testing.assert_array_equal(np.asarray(top_o[0]), want_order)
