@@ -1,0 +1,384 @@
+"""InternalEngine: the per-shard write path and searcher view.
+
+Re-designs the reference engine (ref: index/engine/InternalEngine.java:842
+`index()`, :913 translog add, :1057 indexIntoLucene; LiveVersionMap for
+versioned upserts; CombinedDeletionPolicy for commits) around immutable TPU
+segments:
+
+  * Writes parse into LuceneDocs, get a seqno from the LocalCheckpointTracker,
+    go to the translog, and land in an in-memory indexing buffer.
+  * refresh() freezes the buffer into a new immutable Segment (the analog of
+    Lucene's flush to a new reader) and tombstones superseded copies in older
+    segments via per-segment live masks — deletes never mutate a segment.
+  * Versioning: internal versioning with optimistic concurrency via
+    if_seq_no/if_primary_term (ref: VersionConflictEngineException paths).
+  * flush() persists segments + a commit point; recovery replays the translog
+    above the committed local checkpoint.
+  * merge() compacts segments by rebuilding from live docs' _source (host
+    recompaction; ref: ElasticsearchConcurrentMergeScheduler conceptually).
+
+The searcher view is an immutable snapshot: (segments, live-mask copies)
+pinned at refresh, like Lucene's point-in-time readers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.common.errors import DocumentMissingError, VersionConflictError
+from elasticsearch_tpu.index.segment import Segment, SegmentBuilder
+from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
+from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.mapper.mapper_service import MapperService
+
+
+@dataclass
+class EngineResult:
+    doc_id: str
+    version: int
+    seq_no: int
+    primary_term: int
+    result: str  # created | updated | deleted | not_found
+
+
+@dataclass
+class SegmentView:
+    """One segment plus its live mask frozen at snapshot time."""
+
+    segment: Segment
+    live: np.ndarray  # [n_docs] bool
+    live_epoch: int   # increments when the mask changes; keys device cache
+
+
+class EngineSearcher:
+    """Point-in-time view over the engine's published segments."""
+
+    def __init__(self, views: List[SegmentView]):
+        self.views = views
+
+    @property
+    def n_docs(self) -> int:
+        return sum(int(v.live.sum()) for v in self.views)
+
+    @property
+    def max_docs(self) -> int:
+        return sum(v.segment.n_docs for v in self.views)
+
+
+@dataclass
+class _VersionEntry:
+    seq_no: int
+    version: int
+    deleted: bool
+    # where the latest live copy lives: buffer or (segment_index, ordinal)
+    in_buffer: bool = False
+    seg_idx: int = -1
+    ord: int = -1
+
+
+class InternalEngine:
+    def __init__(
+        self,
+        mapper_service: MapperService,
+        data_path: Optional[str] = None,
+        primary_term: int = 1,
+        translog_durability: str = "request",
+    ):
+        self.mapper = mapper_service
+        self.primary_term = primary_term
+        self.data_path = data_path
+        self._lock = threading.RLock()
+        self._seqno = LocalCheckpointTracker()
+        self._versions: Dict[str, _VersionEntry] = {}  # LiveVersionMap analog
+        self._buffer: Dict[str, tuple] = {}            # id -> (LuceneDoc, seq_no, version)
+        self._buffer_order: List[str] = []
+        self._segments: List[Segment] = []
+        self._live: List[np.ndarray] = []
+        self._live_epochs: List[int] = []
+        self._next_seg_id = 0
+        self._last_committed_checkpoint = NO_OPS_PERFORMED
+        self._refresh_listeners: List = []
+        if data_path is not None:
+            os.makedirs(data_path, exist_ok=True)
+            self.translog = Translog(os.path.join(data_path, "translog"), translog_durability)
+            self._maybe_recover()
+        else:
+            self.translog = None
+
+    # ---------------- write path ----------------
+
+    def index(
+        self,
+        doc_id: str,
+        source: dict,
+        *,
+        seq_no: Optional[int] = None,
+        if_seq_no: Optional[int] = None,
+        if_primary_term: Optional[int] = None,
+        op_type: str = "index",
+        from_translog: bool = False,
+    ) -> EngineResult:
+        """Index or update one document (ref: InternalEngine.index:842)."""
+        with self._lock:
+            entry = self._versions.get(doc_id)
+            exists = entry is not None and not entry.deleted
+            if if_seq_no is not None or if_primary_term is not None:
+                cur_seq = entry.seq_no if entry else NO_OPS_PERFORMED
+                if not exists or cur_seq != if_seq_no or self.primary_term != if_primary_term:
+                    raise VersionConflictError(
+                        f"[{doc_id}]: version conflict, required seqNo [{if_seq_no}], "
+                        f"primary term [{if_primary_term}], current document has seqNo [{cur_seq}]"
+                    )
+            if op_type == "create" and exists:
+                raise VersionConflictError(
+                    f"[{doc_id}]: version conflict, document already exists "
+                    f"(current version [{entry.version}])"
+                )
+            doc = self.mapper.parse(doc_id, source)
+            seq = seq_no if seq_no is not None else self._seqno.generate_seq_no()
+            version = (entry.version + 1) if entry is not None else 1
+            # tombstone a previous published copy
+            if entry is not None and not entry.in_buffer and entry.seg_idx >= 0:
+                self._tombstone(entry.seg_idx, entry.ord)
+            self._buffer[doc_id] = (doc, seq, version)
+            if not (entry is not None and entry.in_buffer):
+                self._buffer_order.append(doc_id)
+            self._versions[doc_id] = _VersionEntry(seq_no=seq, version=version, deleted=False, in_buffer=True)
+            if self.translog is not None and not from_translog:
+                self.translog.add(
+                    {"op": "index", "id": doc_id, "seq_no": seq,
+                     "primary_term": self.primary_term, "version": version, "source": source}
+                )
+            self._seqno.mark_processed(seq)
+            return EngineResult(doc_id, version, seq, self.primary_term,
+                                "updated" if exists else "created")
+
+    def delete(
+        self,
+        doc_id: str,
+        *,
+        seq_no: Optional[int] = None,
+        if_seq_no: Optional[int] = None,
+        if_primary_term: Optional[int] = None,
+        from_translog: bool = False,
+    ) -> EngineResult:
+        with self._lock:
+            entry = self._versions.get(doc_id)
+            exists = entry is not None and not entry.deleted
+            if if_seq_no is not None or if_primary_term is not None:
+                cur_seq = entry.seq_no if entry else NO_OPS_PERFORMED
+                if not exists or cur_seq != if_seq_no or self.primary_term != if_primary_term:
+                    raise VersionConflictError(
+                        f"[{doc_id}]: version conflict on delete, required seqNo [{if_seq_no}]"
+                    )
+            seq = seq_no if seq_no is not None else self._seqno.generate_seq_no()
+            if not exists:
+                self._seqno.mark_processed(seq)
+                return EngineResult(doc_id, entry.version if entry else 1, seq,
+                                    self.primary_term, "not_found")
+            version = entry.version + 1
+            if entry.in_buffer:
+                self._buffer.pop(doc_id, None)
+                if doc_id in self._buffer_order:
+                    self._buffer_order.remove(doc_id)
+            elif entry.seg_idx >= 0:
+                self._tombstone(entry.seg_idx, entry.ord)
+            self._versions[doc_id] = _VersionEntry(seq_no=seq, version=version, deleted=True)
+            if self.translog is not None and not from_translog:
+                self.translog.add({"op": "delete", "id": doc_id, "seq_no": seq,
+                                   "primary_term": self.primary_term, "version": version})
+            self._seqno.mark_processed(seq)
+            return EngineResult(doc_id, version, seq, self.primary_term, "deleted")
+
+    def _tombstone(self, seg_idx: int, ord_: int) -> None:
+        self._live[seg_idx][ord_] = False
+        self._live_epochs[seg_idx] += 1
+
+    # ---------------- reads ----------------
+
+    def get(self, doc_id: str) -> Optional[dict]:
+        """Realtime get (ref: InternalEngine.get — reads from the version map /
+        translog before refresh makes the doc searchable)."""
+        with self._lock:
+            entry = self._versions.get(doc_id)
+            if entry is None or entry.deleted:
+                return None
+            if entry.in_buffer:
+                doc, seq, version = self._buffer[doc_id]
+                return {"_id": doc_id, "_version": version, "_seq_no": seq,
+                        "_primary_term": self.primary_term, "_source": doc.source}
+            seg = self._segments[entry.seg_idx]
+            return {"_id": doc_id, "_version": entry.version, "_seq_no": entry.seq_no,
+                    "_primary_term": self.primary_term, "_source": seg.sources[entry.ord]}
+
+    def acquire_searcher(self) -> EngineSearcher:
+        with self._lock:
+            views = [
+                SegmentView(segment=s, live=self._live[i].copy(), live_epoch=self._live_epochs[i])
+                for i, s in enumerate(self._segments)
+            ]
+            return EngineSearcher(views)
+
+    # ---------------- refresh / flush / merge ----------------
+
+    def refresh(self) -> bool:
+        """Freeze the indexing buffer into a new searchable segment."""
+        with self._lock:
+            if not self._buffer_order:
+                return False
+            builder = SegmentBuilder(seg_id=self._next_seg_id)
+            ords: Dict[str, int] = {}
+            for doc_id in self._buffer_order:
+                if doc_id not in self._buffer:
+                    continue
+                doc, seq, version = self._buffer[doc_id]
+                ords[doc_id] = builder.add(doc, seq_no=seq, version=version)
+            segment = builder.build()
+            seg_idx = len(self._segments)
+            self._segments.append(segment)
+            self._live.append(np.ones(segment.n_docs, bool))
+            self._live_epochs.append(0)
+            self._next_seg_id += 1
+            for doc_id, ord_ in ords.items():
+                entry = self._versions[doc_id]
+                entry.in_buffer = False
+                entry.seg_idx = seg_idx
+                entry.ord = ord_
+            self._buffer.clear()
+            self._buffer_order.clear()
+            return True
+
+    def flush(self) -> None:
+        """Commit: persist segments + metadata, roll translog generation.
+
+        Ref: InternalEngine.flush — Lucene commit + translog rollover. Segment
+        payloads are pickled host arrays (the segment IS the checkpoint;
+        SURVEY.md §5.4)."""
+        if self.data_path is None:
+            return
+        with self._lock:
+            self.refresh()
+            seg_dir = os.path.join(self.data_path, "segments")
+            os.makedirs(seg_dir, exist_ok=True)
+            names = []
+            for i, seg in enumerate(self._segments):
+                name = f"seg-{seg.seg_id}.pkl"
+                path = os.path.join(seg_dir, name)
+                if not os.path.exists(path):
+                    with open(path + ".tmp", "wb") as f:
+                        pickle.dump(seg, f, protocol=pickle.HIGHEST_PROTOCOL)
+                    os.replace(path + ".tmp", path)
+                names.append({"file": name, "live": self._live[i].tolist()})
+            gen = self.translog.rollover()
+            commit = {
+                "segments": names,
+                "local_checkpoint": self._seqno.checkpoint,
+                "max_seq_no": self._seqno.max_seq_no,
+                "translog_generation": gen,
+                "primary_term": self.primary_term,
+            }
+            tmp = os.path.join(self.data_path, "commit.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(commit, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(self.data_path, "commit.json"))
+            self._last_committed_checkpoint = self._seqno.checkpoint
+            self.translog.trim_below(gen)
+
+    def _maybe_recover(self) -> None:
+        """Crash recovery: load committed segments, replay translog tail
+        (ref: index/shard/StoreRecovery.java + translog replay)."""
+        commit_path = os.path.join(self.data_path, "commit.json")
+        committed_cp = NO_OPS_PERFORMED
+        if os.path.exists(commit_path):
+            with open(commit_path) as f:
+                commit = json.load(f)
+            committed_cp = commit["local_checkpoint"]
+            self.primary_term = max(self.primary_term, commit.get("primary_term", 1))
+            self._seqno = LocalCheckpointTracker(
+                max_seq_no=commit["max_seq_no"], local_checkpoint=committed_cp
+            )
+            seg_dir = os.path.join(self.data_path, "segments")
+            for meta in commit["segments"]:
+                with open(os.path.join(seg_dir, meta["file"]), "rb") as f:
+                    seg: Segment = pickle.load(f)
+                seg_idx = len(self._segments)
+                live = np.asarray(meta["live"], bool)
+                self._segments.append(seg)
+                self._live.append(live)
+                self._live_epochs.append(0)
+                self._next_seg_id = max(self._next_seg_id, seg.seg_id + 1)
+                for ord_, doc_id in enumerate(seg.doc_ids):
+                    if live[ord_]:
+                        self._versions[doc_id] = _VersionEntry(
+                            seq_no=int(seg.seq_nos[ord_]), version=int(seg.versions[ord_]),
+                            deleted=False, in_buffer=False, seg_idx=seg_idx, ord=ord_,
+                        )
+                        self._seqno.mark_processed(int(seg.seq_nos[ord_]))
+        # replay translog tail
+        for op in self.translog.read_ops(min_seq_no=committed_cp):
+            if op["op"] == "index":
+                self.index(op["id"], op["source"], seq_no=op["seq_no"], from_translog=True)
+            else:
+                self.delete(op["id"], seq_no=op["seq_no"], from_translog=True)
+
+    def force_merge(self, max_num_segments: int = 1) -> None:
+        """Compact segments by rebuilding live docs (host recompaction)."""
+        with self._lock:
+            self.refresh()
+            if len(self._segments) <= max_num_segments:
+                return
+            builder = SegmentBuilder(seg_id=self._next_seg_id)
+            ords: Dict[str, int] = {}
+            for seg_idx, seg in enumerate(self._segments):
+                live = self._live[seg_idx]
+                for ord_ in range(seg.n_docs):
+                    if live[ord_]:
+                        doc_id = seg.doc_ids[ord_]
+                        doc = self.mapper.parse(doc_id, seg.sources[ord_])
+                        ords[doc_id] = builder.add(doc, seq_no=int(seg.seq_nos[ord_]),
+                                                   version=int(seg.versions[ord_]))
+            merged = builder.build()
+            self._segments = [merged]
+            self._live = [np.ones(merged.n_docs, bool)]
+            self._live_epochs = [0]
+            self._next_seg_id += 1
+            for doc_id, ord_ in ords.items():
+                entry = self._versions[doc_id]
+                entry.seg_idx = 0
+                entry.ord = ord_
+
+    # ---------------- stats ----------------
+
+    @property
+    def local_checkpoint(self) -> int:
+        return self._seqno.checkpoint
+
+    @property
+    def max_seq_no(self) -> int:
+        return self._seqno.max_seq_no
+
+    @property
+    def seqno_tracker(self) -> LocalCheckpointTracker:
+        return self._seqno
+
+    def doc_count(self) -> int:
+        with self._lock:
+            n = sum(int(l.sum()) for l in self._live)
+            n += len([d for d in self._buffer_order if d in self._buffer])
+            return n
+
+    def segment_count(self) -> int:
+        return len(self._segments)
+
+    def close(self) -> None:
+        if self.translog is not None:
+            self.translog.close()

@@ -133,6 +133,7 @@ class Segment:
         keyword: Dict[str, KeywordColumn],
         vectors: Dict[str, VectorColumn],
         seq_nos: np.ndarray,
+        versions: np.ndarray | None = None,
     ):
         self.seg_id = seg_id
         self.n_docs = len(doc_ids)
@@ -144,7 +145,19 @@ class Segment:
         self.keyword = keyword
         self.vectors = vectors
         self.seq_nos = seq_nos          # [n_docs] i64 — seqno of each op
+        self.versions = versions if versions is not None else np.ones(self.n_docs, np.int64)
         self._device: dict = {}
+        self._device_lock = threading.Lock()
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_device"] = {}          # device arrays are never persisted
+        state.pop("_device_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._device = {}
         self._device_lock = threading.Lock()
 
     # ---- stats (combined at shard level for idf/avgdl) ----
@@ -232,10 +245,12 @@ class SegmentBuilder:
         self.seg_id = seg_id
         self._docs: List[LuceneDoc] = []
         self._seq_nos: List[int] = []
+        self._versions: List[int] = []
 
-    def add(self, doc: LuceneDoc, seq_no: int = -1) -> int:
+    def add(self, doc: LuceneDoc, seq_no: int = -1, version: int = 1) -> int:
         self._docs.append(doc)
         self._seq_nos.append(seq_no)
+        self._versions.append(version)
         return len(self._docs) - 1
 
     def __len__(self) -> int:
@@ -280,6 +295,7 @@ class SegmentBuilder:
             keyword=keyword,
             vectors=vectors,
             seq_nos=np.asarray(self._seq_nos, np.int64),
+            versions=np.asarray(self._versions, np.int64),
         )
 
     # ---- builders ----

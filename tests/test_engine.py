@@ -1,0 +1,187 @@
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.errors import VersionConflictError
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, ReplicationTracker
+from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.mapper import MapperService
+
+MAPPING = {"properties": {"body": {"type": "text"}, "n": {"type": "long"}}}
+
+
+def make_engine(path=None):
+    return InternalEngine(MapperService(dict(MAPPING)), data_path=path)
+
+
+def test_index_get_update_delete_lifecycle():
+    e = make_engine()
+    r = e.index("1", {"body": "hello world", "n": 1})
+    assert (r.result, r.version, r.seq_no) == ("created", 1, 0)
+    got = e.get("1")
+    assert got["_source"]["n"] == 1 and got["_version"] == 1
+    r2 = e.index("1", {"body": "hello again", "n": 2})
+    assert (r2.result, r2.version) == ("updated", 2)
+    assert e.get("1")["_source"]["n"] == 2
+    r3 = e.delete("1")
+    assert (r3.result, r3.version) == ("deleted", 3)
+    assert e.get("1") is None
+    assert e.delete("1").result == "not_found"
+
+
+def test_realtime_get_before_refresh_and_searchable_after():
+    e = make_engine()
+    e.index("a", {"body": "x"})
+    assert e.get("a") is not None          # realtime from buffer
+    searcher = e.acquire_searcher()
+    assert searcher.n_docs == 0            # not yet refreshed
+    assert e.refresh() is True
+    assert e.acquire_searcher().n_docs == 1
+    assert e.refresh() is False            # nothing new
+
+
+def test_update_across_segments_tombstones_old_copy():
+    e = make_engine()
+    e.index("a", {"body": "v1"})
+    e.index("b", {"body": "other"})
+    e.refresh()
+    e.index("a", {"body": "v2"})
+    e.refresh()
+    s = e.acquire_searcher()
+    assert len(s.views) == 2
+    assert s.n_docs == 2                   # old copy of a is dead
+    assert not s.views[0].live[0]          # a's first copy tombstoned
+    assert e.doc_count() == 2
+
+
+def test_optimistic_concurrency():
+    e = make_engine()
+    r = e.index("a", {"body": "x"})
+    with pytest.raises(VersionConflictError):
+        e.index("a", {"body": "y"}, if_seq_no=99, if_primary_term=1)
+    e.index("a", {"body": "y"}, if_seq_no=r.seq_no, if_primary_term=1)
+    with pytest.raises(VersionConflictError):
+        e.index("a", {"body": "z"}, op_type="create")
+    with pytest.raises(VersionConflictError):
+        e.delete("a", if_seq_no=0, if_primary_term=1)  # seq advanced to 1
+
+
+def test_delete_in_buffer_doc():
+    e = make_engine()
+    e.index("a", {"body": "x"})
+    e.delete("a")
+    e.refresh()
+    assert e.acquire_searcher().n_docs == 0
+    assert e.doc_count() == 0
+
+
+def test_force_merge_compacts_and_preserves():
+    e = make_engine()
+    for i in range(10):
+        e.index(str(i), {"body": f"doc {i}", "n": i})
+        if i % 3 == 0:
+            e.refresh()
+    e.delete("4")
+    e.index("5", {"body": "updated five", "n": 50})
+    e.force_merge()
+    assert e.segment_count() == 1
+    assert e.doc_count() == 9
+    assert e.get("5")["_source"]["n"] == 50
+    assert e.get("5")["_version"] == 2
+    assert e.get("4") is None
+
+
+def test_translog_replay_after_crash(tmp_path):
+    path = str(tmp_path / "shard0")
+    e = make_engine(path)
+    e.index("1", {"body": "one", "n": 1})
+    e.index("2", {"body": "two", "n": 2})
+    e.delete("1")
+    # no flush — simulate crash; reopen
+    e.close()
+    e2 = make_engine(path)
+    assert e2.get("1") is None
+    assert e2.get("2")["_source"]["n"] == 2
+    assert e2.max_seq_no == 2
+    assert e2.local_checkpoint == 2
+    e2.close()
+
+
+def test_flush_commit_and_recover_with_tail(tmp_path):
+    path = str(tmp_path / "shard0")
+    e = make_engine(path)
+    for i in range(5):
+        e.index(str(i), {"body": f"doc {i}", "n": i})
+    e.flush()
+    e.index("5", {"body": "after commit", "n": 5})
+    e.index("0", {"body": "updated zero", "n": 100})
+    e.close()
+
+    e2 = make_engine(path)
+    assert e2.doc_count() == 6
+    assert e2.get("5")["_source"]["n"] == 5
+    assert e2.get("0")["_source"]["n"] == 100
+    assert e2.get("0")["_version"] == 2
+    assert e2.local_checkpoint == 6
+    # translog generations below commit were trimmed
+    assert len(e2.translog.generations()) <= 2
+    e2.close()
+
+
+def test_flush_idempotent_and_live_masks_persisted(tmp_path):
+    path = str(tmp_path / "s")
+    e = make_engine(path)
+    e.index("a", {"body": "x"})
+    e.index("b", {"body": "y"})
+    e.flush()
+    e.delete("a")
+    e.flush()
+    e.close()
+    e2 = make_engine(path)
+    assert e2.doc_count() == 1
+    assert e2.get("a") is None and e2.get("b") is not None
+    e2.close()
+
+
+def test_translog_torn_tail_tolerated(tmp_path):
+    t = Translog(str(tmp_path / "tl"))
+    t.add({"op": "index", "id": "1", "seq_no": 0, "source": {}})
+    t.add({"op": "index", "id": "2", "seq_no": 1, "source": {}})
+    t.close()
+    # append garbage partial record
+    files = [f for f in os.listdir(tmp_path / "tl")]
+    with open(tmp_path / "tl" / files[0], "ab") as f:
+        f.write(b"\x50\x00\x00\x00\x12\x34")
+    t2 = Translog(str(tmp_path / "tl"))
+    ops = list(t2.read_ops())
+    assert [o["id"] for o in ops] == ["1", "2"]
+    t2.close()
+
+
+def test_local_checkpoint_tracker_gaps():
+    t = LocalCheckpointTracker()
+    s0, s1, s2 = t.generate_seq_no(), t.generate_seq_no(), t.generate_seq_no()
+    t.mark_processed(s2)
+    assert t.checkpoint == -1
+    t.mark_processed(s0)
+    assert t.checkpoint == 0
+    t.mark_processed(s1)
+    assert t.checkpoint == 2
+    assert t.max_seq_no == 2
+
+
+def test_replication_tracker_global_checkpoint():
+    rt = ReplicationTracker("p")
+    rt.update_local_checkpoint("p", 5)
+    assert rt.global_checkpoint == 5
+    rt.mark_in_sync("r1")
+    rt.update_local_checkpoint("r1", 3)
+    # min over in-sync set, but never backwards
+    assert rt.global_checkpoint == 5
+    rt.update_local_checkpoint("r1", 7)
+    rt.update_local_checkpoint("p", 9)
+    assert rt.global_checkpoint == 7
+    rt.remove_tracking("r1")
+    assert rt.global_checkpoint == 9
