@@ -84,7 +84,8 @@ class FieldPostings:
 
 @dataclass
 class NumericColumn:
-    values: np.ndarray                  # [n_docs] f64 (first value)
+    values: np.ndarray                  # [n_docs] f64 (min value; asc sort mode)
+    max_values: np.ndarray              # [n_docs] f64 (max value; desc sort mode)
     exists: np.ndarray                  # [n_docs] bool
     # full multi-value CSR for range semantics ("any value in range")
     value_start: np.ndarray             # [n_docs + 1] i64
@@ -106,8 +107,16 @@ class NumericColumn:
 class KeywordColumn:
     terms: List[str]                    # sorted dictionary
     term_to_ord: Dict[str, int]
-    ords: np.ndarray                    # [n_docs] i32, -1 = missing (first value)
+    ords: np.ndarray                    # [n_docs] i32, -1 = missing (min value;
+    #                                     the reference's asc sort mode "min")
+    max_ords: np.ndarray                # [n_docs] i32 (max value; desc sort mode)
     exists: np.ndarray                  # [n_docs] bool
+    ord_start: np.ndarray               # [n_docs + 1] i64 — multivalue CSR
+    all_ords: np.ndarray                # [total_values] i32 (per-doc sorted)
+
+    def doc_terms(self, ord_: int) -> List[str]:
+        lo, hi = int(self.ord_start[ord_]), int(self.ord_start[ord_ + 1])
+        return [self.terms[o] for o in self.all_ords[lo:hi]]
 
 
 @dataclass
@@ -394,6 +403,7 @@ class SegmentBuilder:
     def _build_numeric(self, fname: str, docs: List[LuceneDoc]) -> NumericColumn:
         n = len(docs)
         values = np.zeros(n, np.float64)
+        max_values = np.zeros(n, np.float64)
         exists = np.zeros(n, bool)
         starts = np.zeros(n + 1, np.int64)
         all_parts: List[np.ndarray] = []
@@ -402,14 +412,16 @@ class SegmentBuilder:
             vs = d.numeric.get(fname)
             starts[i] = total
             if vs:
-                values[i] = vs[0]
-                exists[i] = True
                 arr = np.sort(np.asarray(vs, np.float64))
+                values[i] = arr[0]
+                max_values[i] = arr[-1]
+                exists[i] = True
                 all_parts.append(arr)
                 total += len(arr)
         starts[n] = total
         all_values = np.concatenate(all_parts) if all_parts else np.empty(0, np.float64)
-        return NumericColumn(values=values, exists=exists, value_start=starts, all_values=all_values)
+        return NumericColumn(values=values, max_values=max_values, exists=exists,
+                             value_start=starts, all_values=all_values)
 
     def _build_keyword(self, fname: str, docs: List[LuceneDoc]) -> KeywordColumn:
         n = len(docs)
@@ -420,13 +432,26 @@ class SegmentBuilder:
         terms = sorted(vocab)
         term_to_ord = {t: i for i, t in enumerate(terms)}
         ords = np.full(n, -1, np.int32)
+        max_ords = np.full(n, -1, np.int32)
         exists = np.zeros(n, bool)
+        ord_start = np.zeros(n + 1, np.int64)
+        all_parts: List[np.ndarray] = []
+        total = 0
         for i, d in enumerate(docs):
             vs = d.keyword.get(fname)
+            ord_start[i] = total
             if vs:
-                ords[i] = term_to_ord[vs[0]]
+                os_ = sorted({term_to_ord[v] for v in vs})
+                ords[i] = os_[0]
+                max_ords[i] = os_[-1]
                 exists[i] = True
-        return KeywordColumn(terms=terms, term_to_ord=term_to_ord, ords=ords, exists=exists)
+                all_parts.append(np.asarray(os_, np.int32))
+                total += len(os_)
+        ord_start[n] = total
+        all_ords = np.concatenate(all_parts) if all_parts else np.empty(0, np.int32)
+        return KeywordColumn(terms=terms, term_to_ord=term_to_ord, ords=ords,
+                             max_ords=max_ords, exists=exists,
+                             ord_start=ord_start, all_ords=all_ords)
 
     def _build_vectors(self, fname: str, docs: List[LuceneDoc]) -> VectorColumn:
         n = len(docs)

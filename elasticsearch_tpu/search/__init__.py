@@ -1,0 +1,4 @@
+from elasticsearch_tpu.search.queries import Query, parse_query
+from elasticsearch_tpu.search.search_service import execute_search
+
+__all__ = ["Query", "parse_query", "execute_search"]

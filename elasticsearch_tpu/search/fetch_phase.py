@@ -1,0 +1,113 @@
+"""Fetch phase: hydrate winning doc ids into full hits.
+
+Re-designs the reference FetchPhase (ref: search/fetch/FetchPhase.java:71 and
+the subphase chain under search/fetch/subphase/) — _source loading and
+filtering, plus the doc-values `fields` option. Stored fields live host-side
+(sources list per segment), so fetch is pure host work, exactly as the
+reference keeps fetch off the scoring hot path.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, List
+
+from elasticsearch_tpu.index.engine import EngineSearcher
+from elasticsearch_tpu.search.query_phase import ShardHit
+
+
+def filter_source(source: dict, source_spec) -> dict | None:
+    """Apply the request `_source` option: bool | list | {includes, excludes}."""
+    if source_spec is None or source_spec is True:
+        return source
+    if source_spec is False:
+        return None
+    if isinstance(source_spec, str):
+        source_spec = [source_spec]
+    if isinstance(source_spec, list):
+        includes, excludes = source_spec, []
+    else:
+        includes = source_spec.get("includes", source_spec.get("include", []))
+        excludes = source_spec.get("excludes", source_spec.get("exclude", []))
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+    flat = _flatten(source)
+    out_flat = {}
+    for key, value in flat.items():
+        if includes and not any(_match(key, p) for p in includes):
+            continue
+        if any(_match(key, p) for p in excludes):
+            continue
+        out_flat[key] = value
+    return _unflatten(out_flat)
+
+
+def _match(key: str, pattern: str) -> bool:
+    return fnmatch.fnmatchcase(key, pattern) or key.startswith(pattern + ".") or \
+        fnmatch.fnmatchcase(key.split(".")[0], pattern)
+
+
+def _flatten(obj: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in obj.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, f"{key}."))
+        else:
+            out[key] = v
+    return out
+
+
+def _unflatten(flat: dict) -> dict:
+    out: dict = {}
+    for key, v in flat.items():
+        parts = key.split(".")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return out
+
+
+def execute_fetch_phase(
+    searcher: EngineSearcher,
+    hits: List[ShardHit],
+    request: dict,
+    index_name: str,
+) -> List[dict]:
+    source_spec = request.get("_source")
+    fields_spec = request.get("fields")
+    out = []
+    for h in hits:
+        seg = searcher.views[h.leaf_idx].segment
+        hit: dict[str, Any] = {
+            "_index": index_name,
+            "_id": seg.doc_ids[h.ord],
+            "_score": None if h.sort_values is not None else h.score,
+        }
+        src = filter_source(seg.sources[h.ord], source_spec)
+        if src is not None:
+            hit["_source"] = src
+        if fields_spec:
+            hit["fields"] = _fetch_fields(seg, h.ord, fields_spec)
+        if h.sort_values is not None:
+            hit["sort"] = [s.s if hasattr(s, "s") else s for s in h.sort_values]
+        out.append(hit)
+    return out
+
+
+def _fetch_fields(seg, ord_: int, fields_spec) -> dict:
+    """The `fields` API: values from doc-value columns."""
+    out = {}
+    for f in fields_spec:
+        fname = f["field"] if isinstance(f, dict) else f
+        for target, col in seg.numeric.items():
+            if fnmatch.fnmatchcase(target, fname) and col.exists[ord_]:
+                lo, hi = int(col.value_start[ord_]), int(col.value_start[ord_ + 1])
+                out[target] = [float(v) for v in col.all_values[lo:hi]]
+        for target, kc in seg.keyword.items():
+            if fnmatch.fnmatchcase(target, fname) and kc.exists[ord_]:
+                out[target] = kc.doc_terms(ord_)
+    return out

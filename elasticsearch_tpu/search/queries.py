@@ -1,0 +1,281 @@
+"""Query DSL: JSON -> query tree.
+
+Re-designs the reference's 47 QueryBuilder classes (ref: index/query/ —
+MatchQueryBuilder, TermQueryBuilder, BoolQueryBuilder, RangeQueryBuilder,
+ExistsQueryBuilder, IdsQueryBuilder, PrefixQueryBuilder, WildcardQueryBuilder,
+ConstantScoreQueryBuilder, MatchPhraseQueryBuilder; parsed via
+SearchExecutionContext.toQuery index/query/SearchExecutionContext.java:451)
+as plain dataclasses. Parsing is one table-driven function; execution lives
+in search/executor.py (the device side).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+from elasticsearch_tpu.common.errors import ParsingError
+
+
+class Query:
+    pass
+
+
+@dataclass
+class MatchAllQuery(Query):
+    boost: float = 1.0
+
+
+@dataclass
+class MatchNoneQuery(Query):
+    pass
+
+
+@dataclass
+class TermQuery(Query):
+    field: str
+    value: Any
+    boost: float = 1.0
+
+
+@dataclass
+class TermsQuery(Query):
+    field: str
+    values: List[Any]
+    boost: float = 1.0
+
+
+@dataclass
+class MatchQuery(Query):
+    field: str
+    text: str
+    operator: str = "or"           # or | and
+    minimum_should_match: Optional[int] = None
+    boost: float = 1.0
+    fuzziness: Optional[str] = None  # accepted, not yet scored differently
+
+
+@dataclass
+class MatchPhraseQuery(Query):
+    field: str
+    text: str
+    slop: int = 0
+    boost: float = 1.0
+
+
+@dataclass
+class RangeQuery(Query):
+    field: str
+    gte: Any = None
+    gt: Any = None
+    lte: Any = None
+    lt: Any = None
+    boost: float = 1.0
+
+
+@dataclass
+class ExistsQuery(Query):
+    field: str
+    boost: float = 1.0
+
+
+@dataclass
+class IdsQuery(Query):
+    values: List[str]
+    boost: float = 1.0
+
+
+@dataclass
+class PrefixQuery(Query):
+    field: str
+    value: str
+    boost: float = 1.0
+
+
+@dataclass
+class WildcardQuery(Query):
+    field: str
+    value: str
+    boost: float = 1.0
+
+
+@dataclass
+class ConstantScoreQuery(Query):
+    filter: Query = None
+    boost: float = 1.0
+
+
+@dataclass
+class BoolQuery(Query):
+    must: List[Query] = field(default_factory=list)
+    should: List[Query] = field(default_factory=list)
+    filter: List[Query] = field(default_factory=list)
+    must_not: List[Query] = field(default_factory=list)
+    minimum_should_match: Optional[int] = None
+    boost: float = 1.0
+
+
+@dataclass
+class KnnQuery(Query):
+    """Top-level knn search section (ES 8 _search "knn" or query vector)."""
+
+    field: str
+    query_vector: List[float]
+    k: int = 10
+    num_candidates: int = 100
+    filter: Optional[Query] = None
+    boost: float = 1.0
+
+
+@dataclass
+class MultiMatchQuery(Query):
+    fields: List[str]
+    text: str
+    type: str = "best_fields"      # best_fields | most_fields
+    operator: str = "or"
+    boost: float = 1.0
+
+
+@dataclass
+class FunctionScoreQuery(Query):
+    """Minimal function_score: supports weight + field_value_factor."""
+
+    query: Query
+    field_value_factor: Optional[dict] = None
+    weight: float = 1.0
+    boost_mode: str = "multiply"
+    boost: float = 1.0
+
+
+def _one_entry(body: dict, name: str) -> tuple:
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingError(f"[{name}] query malformed, expected a single field object")
+    return next(iter(body.items()))
+
+
+def parse_query(body: dict) -> Query:
+    """Parse the JSON query DSL (the `query` element of a search request)."""
+    if body is None:
+        return MatchAllQuery()
+    if not isinstance(body, dict) or len(body) != 1:
+        raise ParsingError("query malformed, expected a single top-level key")
+    kind, spec = next(iter(body.items()))
+
+    if kind == "match_all":
+        return MatchAllQuery(boost=spec.get("boost", 1.0) if isinstance(spec, dict) else 1.0)
+    if kind == "match_none":
+        return MatchNoneQuery()
+
+    if kind == "match":
+        fname, v = _one_entry(spec, "match")
+        if isinstance(v, dict):
+            return MatchQuery(fname, str(v["query"]), operator=v.get("operator", "or").lower(),
+                              minimum_should_match=_parse_msm(v.get("minimum_should_match")),
+                              boost=v.get("boost", 1.0), fuzziness=v.get("fuzziness"))
+        return MatchQuery(fname, str(v))
+
+    if kind in ("match_phrase", "match_phrase_prefix"):
+        fname, v = _one_entry(spec, kind)
+        if isinstance(v, dict):
+            return MatchPhraseQuery(fname, str(v["query"]), slop=int(v.get("slop", 0)),
+                                    boost=v.get("boost", 1.0))
+        return MatchPhraseQuery(fname, str(v))
+
+    if kind == "term":
+        fname, v = _one_entry(spec, "term")
+        if isinstance(v, dict):
+            return TermQuery(fname, v["value"], boost=v.get("boost", 1.0))
+        return TermQuery(fname, v)
+
+    if kind == "terms":
+        boost = spec.get("boost", 1.0) if isinstance(spec, dict) else 1.0
+        entries = [(k, v) for k, v in spec.items() if k != "boost"]
+        if len(entries) != 1:
+            raise ParsingError("[terms] query requires exactly one field")
+        fname, values = entries[0]
+        if not isinstance(values, list):
+            raise ParsingError("[terms] query requires an array of terms")
+        return TermsQuery(fname, values, boost=boost)
+
+    if kind == "range":
+        fname, v = _one_entry(spec, "range")
+        q = RangeQuery(fname, gte=v.get("gte", v.get("from")), gt=v.get("gt"),
+                       lte=v.get("lte", v.get("to")), lt=v.get("lt"),
+                       boost=v.get("boost", 1.0))
+        return q
+
+    if kind == "exists":
+        return ExistsQuery(spec["field"], boost=spec.get("boost", 1.0))
+
+    if kind == "ids":
+        return IdsQuery([str(x) for x in spec.get("values", [])])
+
+    if kind == "prefix":
+        fname, v = _one_entry(spec, "prefix")
+        if isinstance(v, dict):
+            return PrefixQuery(fname, str(v["value"]), boost=v.get("boost", 1.0))
+        return PrefixQuery(fname, str(v))
+
+    if kind == "wildcard":
+        fname, v = _one_entry(spec, "wildcard")
+        if isinstance(v, dict):
+            return WildcardQuery(fname, str(v.get("value", v.get("wildcard"))), boost=v.get("boost", 1.0))
+        return WildcardQuery(fname, str(v))
+
+    if kind == "constant_score":
+        return ConstantScoreQuery(filter=parse_query(spec["filter"]), boost=spec.get("boost", 1.0))
+
+    if kind == "bool":
+        def _clauses(key):
+            raw = spec.get(key, [])
+            if isinstance(raw, dict):
+                raw = [raw]
+            return [parse_query(c) for c in raw]
+
+        return BoolQuery(
+            must=_clauses("must"),
+            should=_clauses("should"),
+            filter=_clauses("filter"),
+            must_not=_clauses("must_not"),
+            minimum_should_match=_parse_msm(spec.get("minimum_should_match")),
+            boost=spec.get("boost", 1.0),
+        )
+
+    if kind == "multi_match":
+        return MultiMatchQuery(fields=list(spec.get("fields", [])), text=str(spec["query"]),
+                               type=spec.get("type", "best_fields"),
+                               operator=spec.get("operator", "or").lower(),
+                               boost=spec.get("boost", 1.0))
+
+    if kind == "function_score":
+        inner = parse_query(spec.get("query", {"match_all": {}}))
+        fvf = spec.get("field_value_factor")
+        weight = float(spec.get("weight", 1.0))
+        for fn in spec.get("functions", []):
+            if "weight" in fn:
+                weight *= float(fn["weight"])
+            if "field_value_factor" in fn:
+                fvf = fn["field_value_factor"]
+        return FunctionScoreQuery(query=inner, field_value_factor=fvf, weight=weight,
+                                  boost_mode=spec.get("boost_mode", "multiply"),
+                                  boost=spec.get("boost", 1.0))
+
+    if kind == "knn":
+        return KnnQuery(field=spec["field"], query_vector=spec["query_vector"],
+                        k=int(spec.get("k", spec.get("num_candidates", 10))),
+                        num_candidates=int(spec.get("num_candidates", 100)),
+                        filter=parse_query(spec["filter"]) if spec.get("filter") else None,
+                        boost=spec.get("boost", 1.0))
+
+    raise ParsingError(f"unknown query [{kind}]")
+
+
+def _parse_msm(raw) -> Optional[int]:
+    """minimum_should_match: integer forms only (percent forms resolved later)."""
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise ParsingError(f"unsupported minimum_should_match [{raw}]")

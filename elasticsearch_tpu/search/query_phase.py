@@ -1,0 +1,250 @@
+"""Query phase: run the query tree over all segments, collect top hits.
+
+Re-designs the reference QueryPhase (ref: search/query/QueryPhase.java:158
+executeInternal — collector chain assembly, total-hits tracking, sort) for
+dense device execution: per leaf we get (scores, mask), AND in the live mask,
+count totals, and collect top-k with lax.top_k; score-sorted collection stays
+on device, field-sorted collection gathers exact f64 columns host-side.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.index.engine import EngineSearcher
+from elasticsearch_tpu.mapper.mapper_service import MapperService
+from elasticsearch_tpu.ops import masked_top_k
+from elasticsearch_tpu.search import queries as q
+from elasticsearch_tpu.search.executor import LeafContext, QueryExecutor, ShardStats, leaves
+from elasticsearch_tpu.search.queries import parse_query
+
+
+@dataclass
+class ShardHit:
+    leaf_idx: int
+    ord: int
+    score: float
+    global_ord: int
+    sort_values: Optional[List[Any]] = None
+
+
+@dataclass
+class QuerySearchResult:
+    total: int
+    relation: str                      # "eq" | "gte"
+    hits: List[ShardHit]
+    max_score: Optional[float]
+    aggregations: Optional[dict] = None
+
+
+def parse_sort(sort_spec) -> List[Tuple[str, str]]:
+    """Normalize the sort element to [(field, order)]."""
+    if sort_spec is None:
+        return []
+    if isinstance(sort_spec, (str, dict)):
+        sort_spec = [sort_spec]
+    out = []
+    for s in sort_spec:
+        if isinstance(s, str):
+            out.append((s, "desc" if s == "_score" else "asc"))
+        elif isinstance(s, dict):
+            for fname, spec in s.items():
+                order = spec.get("order", "asc") if isinstance(spec, dict) else str(spec)
+                out.append((fname, order))
+    return out
+
+
+def execute_query_phase(
+    searcher: EngineSearcher,
+    mapper: MapperService,
+    request: dict,
+    *,
+    executor: QueryExecutor | None = None,
+) -> QuerySearchResult:
+    lvs = leaves(searcher)
+    stats = ShardStats(searcher.views)
+    ex = executor or QueryExecutor(mapper, stats)
+
+    query = parse_query(request.get("query")) if request.get("query") else None
+    knn_spec = request.get("knn")
+    size = int(request.get("size", 10))
+    from_ = int(request.get("from", 0))
+    min_score = request.get("min_score")
+    sort = parse_sort(request.get("sort"))
+    track = request.get("track_total_hits", 10000)
+    k = from_ + size
+
+    if query is None and knn_spec is None:
+        query = q.MatchAllQuery()
+
+    knn_query = None
+    if knn_spec is not None:
+        if isinstance(knn_spec, list):
+            knn_spec = knn_spec[0]
+        knn_query = q.KnnQuery(
+            field=knn_spec["field"],
+            query_vector=knn_spec["query_vector"],
+            k=int(knn_spec.get("k", 10)),
+            num_candidates=int(knn_spec.get("num_candidates", 100)),
+            filter=parse_query(knn_spec["filter"]) if knn_spec.get("filter") else None,
+            boost=float(knn_spec.get("boost", 1.0)),
+        )
+        if k == from_ + size:
+            k = max(k, knn_query.k)
+
+    total = 0
+    collected: List[ShardHit] = []
+
+    # knn contributes only the k nearest live docs shard-wide (ref: ES 8 knn
+    # section semantics — per-shard top-k then coordinator merge)
+    knn_leaf_results: List[Tuple[np.ndarray, np.ndarray]] = []
+    if knn_query is not None:
+        per_leaf = []
+        for leaf in lvs:
+            ks, km = ex.execute(knn_query, leaf)
+            km = km & leaf.live_dev()
+            per_leaf.append((np.asarray(ks), np.asarray(km)))
+        flat = np.concatenate([np.where(m, s, -np.inf) for s, m in per_leaf]) \
+            if per_leaf else np.empty(0, np.float32)
+        kk = min(knn_query.k, len(flat))
+        keep = np.zeros(len(flat), bool)
+        if kk > 0:
+            top = np.argpartition(-flat, kk - 1)[:kk]
+            keep[top[np.isfinite(flat[top])]] = True
+        off = 0
+        for s, m in per_leaf:
+            knn_leaf_results.append((s, keep[off: off + len(s)]))
+            off += len(s)
+
+    for leaf_idx, leaf in enumerate(lvs):
+        if leaf.n_docs == 0:
+            continue
+        if query is not None:
+            scores, mask = ex.execute(query, leaf)
+        else:
+            scores = jnp.zeros(leaf.n_docs, jnp.float32)
+            mask = jnp.zeros(leaf.n_docs, bool)
+        if knn_query is not None:
+            ks, km = knn_leaf_results[leaf_idx]
+            ks_dev = jnp.asarray(np.where(km, ks, 0.0))
+            km_dev = jnp.asarray(km)
+            # hybrid: scores sum where both match (ES 8 combined knn+query)
+            scores = scores + ks_dev
+            mask = mask | km_dev if query is not None else km_dev
+        mask = mask & leaf.live_dev()
+        if min_score is not None:
+            mask = mask & (scores >= float(min_score))
+        total += int(jnp.sum(mask.astype(jnp.int32)))
+
+        if sort:
+            collected.extend(_collect_sorted(leaf, leaf_idx, scores, mask, sort, k))
+        else:
+            kk = min(k, leaf.n_docs)
+            if kk == 0:
+                continue
+            top_s, top_o, valid = masked_top_k(scores, mask, k=kk)
+            top_s = np.asarray(top_s)
+            top_o = np.asarray(top_o)
+            valid = np.asarray(valid)
+            for s, o, v in zip(top_s, top_o, valid):
+                if v:
+                    collected.append(ShardHit(leaf_idx, int(o), float(s), leaf.base + int(o)))
+
+    if sort:
+        keyed = [(_sort_key(h, sort), h) for h in collected]
+        keyed.sort(key=lambda kv: kv[0])
+        merged = [h for _, h in keyed[:k]]
+    else:
+        collected.sort(key=lambda h: (-h.score, h.global_ord))
+        merged = collected[:k]
+
+    window = merged[from_: from_ + size]
+    max_score = None
+    if not sort and merged:
+        max_score = max(h.score for h in merged)
+
+    relation = "eq"
+    if track is not True and isinstance(track, bool) is False:
+        threshold = int(track)
+        if total > threshold:
+            relation = "gte"
+            total = max(total, threshold)
+    elif track is False:
+        relation = "gte"
+
+    return QuerySearchResult(total=total, relation=relation, hits=window, max_score=max_score)
+
+
+def _collect_sorted(leaf: LeafContext, leaf_idx: int, scores, mask, sort, k) -> List[ShardHit]:
+    mask_np = np.asarray(mask)
+    cand = np.nonzero(mask_np)[0]
+    if len(cand) == 0:
+        return []
+    scores_np = np.asarray(scores)
+    out = []
+    sort_cols = []
+    for fname, order in sort:
+        if fname in ("_score",):
+            sort_cols.append(scores_np[cand])
+        elif fname == "_doc":
+            sort_cols.append(cand.astype(np.float64))
+        else:
+            col = leaf.segment.numeric.get(fname)
+            if col is not None:
+                raw = col.values if order == "asc" else col.max_values
+                vals = np.where(col.exists[cand], raw[cand],
+                                np.inf if order == "asc" else -np.inf)
+                sort_cols.append(vals)
+            else:
+                kc = leaf.segment.keyword.get(fname)
+                if kc is not None:
+                    terms = kc.terms
+                    # multi-valued sort mode: min for asc, max for desc (ref:
+                    # search/sort/FieldSortBuilder default sort modes)
+                    col_ords = kc.ords if order == "asc" else kc.max_ords
+                    missing = "￿" if order == "asc" else ""
+                    vals = [terms[o] if o >= 0 else missing for o in col_ords[cand]]
+                    sort_cols.append(np.asarray(vals, object))
+                else:
+                    sort_cols.append(np.full(len(cand), np.inf))
+    for i, ord_ in enumerate(cand):
+        sv = [c[i] for c in sort_cols]
+        out.append(ShardHit(leaf_idx, int(ord_), float(scores_np[ord_]),
+                            leaf.base + int(ord_), sort_values=sv))
+    # local truncation: sort + cut to k to bound merge cost
+    out.sort(key=lambda h: _sort_key(h, sort))
+    return out[:k]
+
+
+def _sort_key(hit: ShardHit, sort) -> tuple:
+    key = []
+    for (fname, order), v in zip(sort, hit.sort_values):
+        if fname == "_score":
+            v = -v if order == "desc" else v
+            key.append(v)
+        elif isinstance(v, str):
+            key.append(_InvStr(v) if order == "desc" else v)
+        else:
+            key.append(-float(v) if order == "desc" else float(v))
+    key.append(hit.global_ord)
+    return tuple(key)
+
+
+class _InvStr:
+    """Reverse-ordering wrapper for string sort keys."""
+
+    __slots__ = ("s",)
+
+    def __init__(self, s: str):
+        self.s = s
+
+    def __lt__(self, other):
+        return self.s > other.s
+
+    def __eq__(self, other):
+        return self.s == other.s

@@ -1,0 +1,43 @@
+"""Shard-level search entry: query phase + fetch phase -> response body.
+
+The analog of the reference SearchService.executeQueryPhase/executeFetchPhase
+pair (ref: search/SearchService.java:370,574) for a single shard; the
+distributed scatter-gather lives in parallel/ and transport/.
+"""
+
+from __future__ import annotations
+
+import time
+
+from elasticsearch_tpu.index.engine import EngineSearcher
+from elasticsearch_tpu.mapper.mapper_service import MapperService
+from elasticsearch_tpu.search.fetch_phase import execute_fetch_phase
+from elasticsearch_tpu.search.query_phase import execute_query_phase
+
+
+def execute_search(
+    searcher: EngineSearcher,
+    mapper: MapperService,
+    request: dict,
+    index_name: str = "index",
+) -> dict:
+    start = time.monotonic()
+    qr = execute_query_phase(searcher, mapper, request)
+    hits = execute_fetch_phase(searcher, qr.hits, request, index_name)
+    for h, sh in zip(hits, qr.hits):
+        if h["_score"] is None and sh.sort_values is None:
+            h["_score"] = sh.score
+    took = int((time.monotonic() - start) * 1000)
+    resp = {
+        "took": took,
+        "timed_out": False,
+        "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
+        "hits": {
+            "total": {"value": qr.total, "relation": qr.relation},
+            "max_score": qr.max_score,
+            "hits": hits,
+        },
+    }
+    if qr.aggregations is not None:
+        resp["aggregations"] = qr.aggregations
+    return resp

@@ -1,0 +1,258 @@
+"""End-to-end engine + query DSL + query/fetch phase tests."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.search import execute_search
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tags": {"type": "keyword"},
+        "views": {"type": "long"},
+        "price": {"type": "double"},
+        "published": {"type": "date"},
+        "active": {"type": "boolean"},
+        "vec": {"type": "dense_vector", "dims": 4},
+    }
+}
+
+DOCS = {
+    "1": {"title": "quick brown fox", "body": "the quick brown fox jumps over the lazy dog",
+          "tags": ["animal", "classic"], "views": 100, "price": 9.99,
+          "published": "2020-01-01", "active": True, "vec": [1.0, 0.0, 0.0, 0.0]},
+    "2": {"title": "lazy dog", "body": "the dog sleeps all day long, what a lazy dog",
+          "tags": ["animal"], "views": 50, "price": 19.99,
+          "published": "2021-06-15", "active": False, "vec": [0.0, 1.0, 0.0, 0.0]},
+    "3": {"title": "jax on tpu", "body": "jax compiles numerical programs for tpus",
+          "tags": ["tech"], "views": 500, "price": 0.0,
+          "published": "2022-03-10", "active": True, "vec": [0.0, 0.0, 1.0, 0.0]},
+    "4": {"title": "search engines", "body": "search engines rank documents with bm25 scoring",
+          "tags": ["tech", "search"], "views": 250, "price": 49.50,
+          "published": "2023-11-20", "active": True, "vec": [0.9, 0.1, 0.0, 0.0]},
+}
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = InternalEngine(MapperService(dict(MAPPING)))
+    for doc_id, src in DOCS.items():
+        e.index(doc_id, src)
+        if doc_id == "2":
+            e.refresh()  # force multi-segment coverage
+    e.refresh()
+    return e
+
+
+def search(engine, request):
+    return execute_search(engine.acquire_searcher(), engine.mapper, request, "test")
+
+
+def ids(resp):
+    return [h["_id"] for h in resp["hits"]["hits"]]
+
+
+def test_match_all(engine):
+    r = search(engine, {"query": {"match_all": {}}})
+    assert r["hits"]["total"]["value"] == 4
+    assert len(r["hits"]["hits"]) == 4
+    assert all(h["_score"] == 1.0 for h in r["hits"]["hits"])
+
+
+def test_match_ranking_and_idf(engine):
+    r = search(engine, {"query": {"match": {"body": "lazy dog"}}})
+    assert ids(r)[0] == "2"  # two "lazy"+"dog" occurrences ranks first
+    assert r["hits"]["total"]["value"] == 2
+    assert r["hits"]["max_score"] == r["hits"]["hits"][0]["_score"] > 0
+
+
+def test_match_operator_and(engine):
+    r_or = search(engine, {"query": {"match": {"body": "quick tpus"}}})
+    assert r_or["hits"]["total"]["value"] == 2
+    r_and = search(engine, {"query": {"match": {"body": {"query": "quick fox", "operator": "and"}}}})
+    assert ids(r_and) == ["1"]
+
+
+def test_term_keyword_and_numeric(engine):
+    r = search(engine, {"query": {"term": {"tags": "tech"}}})
+    assert sorted(ids(r)) == ["3", "4"]
+    r = search(engine, {"query": {"term": {"views": 500}}})
+    assert ids(r) == ["3"]
+    r = search(engine, {"query": {"term": {"active": "true"}}})
+    assert sorted(ids(r)) == ["1", "3", "4"]
+
+
+def test_terms_query(engine):
+    r = search(engine, {"query": {"terms": {"tags": ["classic", "search"]}}})
+    assert sorted(ids(r)) == ["1", "4"]
+
+
+def test_range_numeric_and_date(engine):
+    r = search(engine, {"query": {"range": {"views": {"gte": 100, "lt": 500}}}})
+    assert sorted(ids(r)) == ["1", "4"]
+    r = search(engine, {"query": {"range": {"published": {"gte": "2021-01-01", "lte": "2022-12-31"}}}})
+    assert sorted(ids(r)) == ["2", "3"]
+    r = search(engine, {"query": {"range": {"price": {"gt": 9.99}}}})
+    assert sorted(ids(r)) == ["2", "4"]
+
+
+def test_bool_query_combinations(engine):
+    r = search(engine, {"query": {"bool": {
+        "must": [{"match": {"body": "dog"}}],
+        "filter": [{"term": {"tags": "animal"}}],
+        "must_not": [{"term": {"active": True}}],
+    }}})
+    assert ids(r) == ["2"]
+    r = search(engine, {"query": {"bool": {
+        "should": [{"term": {"tags": "classic"}}, {"term": {"tags": "search"}}],
+    }}})
+    assert sorted(ids(r)) == ["1", "4"]
+    r = search(engine, {"query": {"bool": {
+        "should": [{"term": {"tags": "animal"}}, {"term": {"active": True}},
+                   {"range": {"views": {"gte": 200}}}],
+        "minimum_should_match": 2,
+    }}})
+    assert sorted(ids(r)) == ["1", "3", "4"]
+
+
+def test_bool_filter_only_scores_zero(engine):
+    r = search(engine, {"query": {"bool": {"filter": [{"term": {"tags": "tech"}}]}}})
+    assert all(h["_score"] == 0.0 for h in r["hits"]["hits"])
+
+
+def test_match_phrase(engine):
+    r = search(engine, {"query": {"match_phrase": {"body": "quick brown fox"}}})
+    assert ids(r) == ["1"]
+    r = search(engine, {"query": {"match_phrase": {"body": "fox brown"}}})
+    assert ids(r) == []
+    r = search(engine, {"query": {"match_phrase": {"body": {"query": "quick fox", "slop": 1}}}})
+    assert ids(r) == ["1"]
+
+
+def test_exists_prefix_wildcard_ids(engine):
+    r = search(engine, {"query": {"exists": {"field": "price"}}})
+    assert r["hits"]["total"]["value"] == 4
+    r = search(engine, {"query": {"prefix": {"tags": "cla"}}})
+    assert ids(r) == ["1"]
+    r = search(engine, {"query": {"wildcard": {"tags": "se*ch"}}})
+    assert ids(r) == ["4"]
+    r = search(engine, {"query": {"ids": {"values": ["2", "3"]}}})
+    assert sorted(ids(r)) == ["2", "3"]
+
+
+def test_constant_score_and_boost(engine):
+    r = search(engine, {"query": {"constant_score": {"filter": {"term": {"tags": "tech"}}, "boost": 2.5}}})
+    assert all(h["_score"] == 2.5 for h in r["hits"]["hits"])
+
+
+def test_multi_match(engine):
+    r = search(engine, {"query": {"multi_match": {"query": "fox engines", "fields": ["title", "body"]}}})
+    assert set(ids(r)) == {"1", "4"}
+
+
+def test_function_score(engine):
+    r = search(engine, {"query": {"function_score": {
+        "query": {"term": {"tags": "tech"}},
+        "functions": [{"field_value_factor": {"field": "views", "factor": 1.0, "modifier": "none"}}],
+    }}})
+    assert ids(r)[0] == "3"  # 500 views beats 250
+
+
+def test_pagination_and_size(engine):
+    r = search(engine, {"query": {"match_all": {}}, "size": 2, "sort": [{"views": {"order": "desc"}}]})
+    assert ids(r) == ["3", "4"]
+    r2 = search(engine, {"query": {"match_all": {}}, "size": 2, "from": 2,
+                         "sort": [{"views": {"order": "desc"}}]})
+    assert ids(r2) == ["1", "2"]
+
+
+def test_sort_by_field_asc_desc_and_sort_values(engine):
+    r = search(engine, {"query": {"match_all": {}}, "sort": [{"price": "asc"}]})
+    assert ids(r) == ["3", "1", "2", "4"]
+    assert r["hits"]["hits"][0]["sort"] == [0.0]
+    r = search(engine, {"query": {"match_all": {}}, "sort": [{"published": {"order": "desc"}}]})
+    assert ids(r) == ["4", "3", "2", "1"]
+
+
+def test_sort_by_keyword(engine):
+    r = search(engine, {"query": {"term": {"tags": "tech"}}, "sort": [{"tags": "asc"}]})
+    assert ids(r) == ["4", "3"]  # "search" < "tech"... doc4 first keyword is "tech"? check below
+
+
+def test_knn_section(engine):
+    r = search(engine, {"knn": {"field": "vec", "query_vector": [1.0, 0.05, 0.0, 0.0], "k": 2}})
+    assert ids(r)[0] in ("1", "4")
+    assert len(ids(r)) == 2
+
+
+def test_knn_with_filter(engine):
+    r = search(engine, {"knn": {"field": "vec", "query_vector": [1.0, 0.0, 0.0, 0.0], "k": 4,
+                                "filter": {"term": {"tags": "tech"}}}, "size": 4})
+    assert set(ids(r)) <= {"3", "4"}
+
+
+def test_hybrid_query_plus_knn(engine):
+    r = search(engine, {"query": {"match": {"body": "bm25 scoring"}},
+                        "knn": {"field": "vec", "query_vector": [0.9, 0.1, 0.0, 0.0], "k": 2}})
+    assert ids(r)[0] == "4"  # matches both text and vector
+
+
+def test_source_filtering(engine):
+    r = search(engine, {"query": {"ids": {"values": ["1"]}}, "_source": ["title", "views"]})
+    src = r["hits"]["hits"][0]["_source"]
+    assert set(src) == {"title", "views"}
+    r = search(engine, {"query": {"ids": {"values": ["1"]}}, "_source": False})
+    assert "_source" not in r["hits"]["hits"][0]
+    r = search(engine, {"query": {"ids": {"values": ["1"]}},
+                        "_source": {"excludes": ["vec", "body"]}})
+    src = r["hits"]["hits"][0]["_source"]
+    assert "vec" not in src and "body" not in src and "title" in src
+
+
+def test_fields_api(engine):
+    r = search(engine, {"query": {"ids": {"values": ["4"]}}, "fields": ["views", "tags"]})
+    f = r["hits"]["hits"][0]["fields"]
+    assert f["views"] == [250.0]
+    assert f["tags"] == ["search", "tech"]  # doc-values (sorted set) order
+
+
+def test_track_total_hits(engine):
+    r = search(engine, {"query": {"match_all": {}}, "track_total_hits": 2, "size": 1})
+    assert r["hits"]["total"]["relation"] == "gte"
+    r = search(engine, {"query": {"match_all": {}}, "track_total_hits": True})
+    assert r["hits"]["total"] == {"value": 4, "relation": "eq"}
+
+
+def test_deleted_docs_invisible(engine):
+    # fresh engine to avoid mutating the module fixture
+    e = InternalEngine(MapperService(dict(MAPPING)))
+    for doc_id, src in DOCS.items():
+        e.index(doc_id, src)
+    e.refresh()
+    e.delete("1")
+    r = execute_search(e.acquire_searcher(), e.mapper, {"query": {"match": {"body": "fox"}}}, "t")
+    assert r["hits"]["total"]["value"] == 0
+
+
+def test_scores_consistent_across_segmentation():
+    """BM25 must be identical whether docs are in 1 segment or 3 (shard stats)."""
+    def build(refresh_points):
+        e = InternalEngine(MapperService(dict(MAPPING)))
+        for i, (doc_id, src) in enumerate(DOCS.items()):
+            e.index(doc_id, src)
+            if i in refresh_points:
+                e.refresh()
+        e.refresh()
+        return e
+
+    req = {"query": {"match": {"body": "the lazy dog"}}}
+    r1 = execute_search(build(set()).acquire_searcher(), MapperService(dict(MAPPING)), req, "t")
+    r2 = execute_search(build({0, 2}).acquire_searcher(), MapperService(dict(MAPPING)), req, "t")
+    s1 = {h["_id"]: h["_score"] for h in r1["hits"]["hits"]}
+    s2 = {h["_id"]: h["_score"] for h in r2["hits"]["hits"]}
+    assert s1.keys() == s2.keys()
+    for k in s1:
+        assert s1[k] == pytest.approx(s2[k], rel=1e-5)
