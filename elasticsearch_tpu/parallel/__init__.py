@@ -1,0 +1,24 @@
+from elasticsearch_tpu.parallel.routing import shard_for_id, murmur3_hash
+from elasticsearch_tpu.parallel.spmd import (
+    StackedBM25,
+    StackedKnn,
+    build_stacked_bm25,
+    build_stacked_knn,
+    make_mesh,
+    sharded_bm25_topk,
+    sharded_knn_topk,
+    prepare_query_blocks,
+)
+
+__all__ = [
+    "shard_for_id",
+    "murmur3_hash",
+    "StackedBM25",
+    "StackedKnn",
+    "build_stacked_bm25",
+    "build_stacked_knn",
+    "make_mesh",
+    "sharded_bm25_topk",
+    "sharded_knn_topk",
+    "prepare_query_blocks",
+]

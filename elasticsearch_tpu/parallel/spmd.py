@@ -1,0 +1,389 @@
+"""SPMD query execution over a (dp, shard) device mesh.
+
+The TPU-native answer to the reference's scatter-gather fan-out
+(ref: action/search/AbstractSearchAsyncAction.java:188 — one RPC per shard,
+then SearchPhaseController.sortDocs top-k merge at the coordinator, and
+QueryPhaseResultConsumer's incremental reduce): instead of RPCs, the whole
+corpus lives sharded across the mesh and a *single compiled program* does
+
+    score local shard -> local top-k -> all_gather(k results over 'shard')
+    -> vectorized k-way merge on every device
+
+Mesh axes:
+  dp    — query-batch data parallelism (the _msearch axis; SURVEY.md P3:
+          "batch many queries per step")
+  shard — corpus partition (SURVEY.md P1 document partitioning); postings are
+          sharded along it, queries replicated along it.
+
+Collectives ride ICI (all_gather of [Q,k] is tiny vs the scoring work).
+Host-side metadata (term dictionaries) maps query terms to per-shard block
+ids before launch; global idf/avgdl come from cluster-wide stats so every
+shard scores identically (ref P5: DFS term-stats round -> here a host-side
+constant because stats live with the shard metadata).
+
+All shapes are padded to identical per-shard maxima so arrays stack to
+[S, ...] and shard cleanly: padding rows point at the reserved zero block and
+contribute nothing (see ops/scoring.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from elasticsearch_tpu.index.segment import FieldPostings, Segment
+from elasticsearch_tpu.ops import BLOCK, bm25_idf, next_bucket
+
+K1 = 1.2
+B = 0.75
+
+
+def make_mesh(n_devices: int | None = None, dp: int = 1, devices=None) -> Mesh:
+    """Build a (dp, shard) mesh over the available devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    n = len(devs)
+    if n % dp != 0:
+        raise ValueError(f"dp={dp} does not divide device count {n}")
+    arr = np.asarray(devs).reshape(dp, n // dp)
+    return Mesh(arr, axis_names=("dp", "shard"))
+
+
+# --------------------------------------------------------------------------
+# Stacked (shardable) index state
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class StackedBM25:
+    """One text field's postings for all shards, padded and stacked."""
+
+    field: str
+    block_docs: jax.Array       # [S, T, 128] i32 (device, sharded over 'shard')
+    block_tfs: jax.Array        # [S, T, 128] f32
+    doc_len: jax.Array          # [S, D] f32
+    live: jax.Array             # [S, D] bool
+    n_shards: int
+    max_docs: int               # D (padded)
+    doc_counts: List[int]       # real docs per shard
+    avgdl: float                # global average doc length
+    total_docs: int             # global doc count (idf denominator)
+    postings: List[FieldPostings]  # host metadata per shard (term -> blocks)
+
+    def sharding(self, mesh: Mesh):
+        return NamedSharding(mesh, P(None, "shard"))
+
+
+@dataclass
+class StackedKnn:
+    field: str
+    vectors: jax.Array          # [S, D, dims] bf16
+    norms: jax.Array            # [S, D] f32
+    exists: jax.Array           # [S, D] bool
+    live: jax.Array             # [S, D] bool
+    n_shards: int
+    max_docs: int
+    similarity: str
+
+
+def _pad_stack(arrays: Sequence[np.ndarray], shape: Tuple[int, ...], dtype) -> np.ndarray:
+    out = np.zeros((len(arrays),) + shape, dtype)
+    for i, a in enumerate(arrays):
+        sl = tuple(slice(0, s) for s in a.shape)
+        out[i][sl] = a
+    return out
+
+
+def build_stacked_bm25(
+    segments: Sequence[Segment],
+    field: str,
+    live_masks: Sequence[np.ndarray] | None = None,
+    mesh: Mesh | None = None,
+) -> StackedBM25:
+    """Stack per-shard single segments into shardable arrays.
+
+    Each shard must be compacted to one segment (force_merge) — the stacked
+    layout is the serving snapshot for the SPMD path, rebuilt on refresh the
+    way the reference's searchable snapshot mounts a point-in-time commit.
+    """
+    fps = []
+    for seg in segments:
+        fp = seg.postings.get(field)
+        if fp is None:
+            # empty shard: synthesize an empty postings table
+            fp = FieldPostings(
+                field=field, term_to_ord={}, terms=[],
+                doc_freq=np.zeros(0, np.int32), total_term_freq=np.zeros(0, np.int64),
+                block_start=np.zeros(0, np.int32), block_count=np.zeros(0, np.int32),
+                block_docs=np.zeros((1, BLOCK), np.int32), block_tfs=np.zeros((1, BLOCK), np.float32),
+                block_max_tf=np.zeros(1, np.float32),
+                post_start=np.zeros(1, np.int64), post_doc=np.zeros(0, np.int32),
+                pos_start=np.zeros(1, np.int64), pos_data=np.zeros(0, np.int32),
+                doc_len=np.zeros(max(seg.n_docs, 1), np.float32), sum_doc_len=0.0,
+            )
+        fps.append(fp)
+
+    S = len(segments)
+    T = max(fp.block_docs.shape[0] for fp in fps)
+    D = max(max(seg.n_docs, 1) for seg in segments)
+    block_docs = _pad_stack([fp.block_docs for fp in fps], (T, BLOCK), np.int32)
+    block_tfs = _pad_stack([fp.block_tfs for fp in fps], (T, BLOCK), np.float32)
+    doc_len = _pad_stack([fp.doc_len for fp in fps], (D,), np.float32)
+    if live_masks is None:
+        live_np = [np.ones(seg.n_docs, bool) for seg in segments]
+    else:
+        live_np = list(live_masks)
+    live = _pad_stack(live_np, (D,), bool)
+
+    total_docs = sum(seg.n_docs for seg in segments)
+    n_field = sum(int(np.count_nonzero(fp.doc_len)) for fp in fps)
+    sum_dl = sum(fp.sum_doc_len for fp in fps)
+    avgdl = (sum_dl / n_field) if n_field else 1.0
+
+    put = partial(_put_sharded, mesh=mesh)
+    return StackedBM25(
+        field=field,
+        block_docs=put(block_docs),
+        block_tfs=put(block_tfs),
+        doc_len=put(doc_len),
+        live=put(live),
+        n_shards=S,
+        max_docs=D,
+        doc_counts=[seg.n_docs for seg in segments],
+        avgdl=float(avgdl),
+        total_docs=total_docs,
+        postings=fps,
+    )
+
+
+def build_stacked_knn(
+    segments: Sequence[Segment],
+    field: str,
+    live_masks: Sequence[np.ndarray] | None = None,
+    mesh: Mesh | None = None,
+) -> StackedKnn:
+    S = len(segments)
+    dims = 1
+    sim = "cosine"
+    for seg in segments:
+        vc = seg.vectors.get(field)
+        if vc is not None and vc.dims:
+            dims = vc.dims
+            sim = vc.similarity
+            break
+    D = max(max(seg.n_docs, 1) for seg in segments)
+    vecs, norms, exists = [], [], []
+    for seg in segments:
+        vc = seg.vectors.get(field)
+        if vc is None:
+            vecs.append(np.zeros((max(seg.n_docs, 1), dims), np.float32))
+            norms.append(np.zeros(max(seg.n_docs, 1), np.float32))
+            exists.append(np.zeros(max(seg.n_docs, 1), bool))
+        else:
+            vecs.append(vc.vectors)
+            norms.append(vc.norms)
+            exists.append(vc.exists)
+    if live_masks is None:
+        live_np = [np.ones(seg.n_docs, bool) for seg in segments]
+    else:
+        live_np = list(live_masks)
+    put = partial(_put_sharded, mesh=mesh)
+    return StackedKnn(
+        field=field,
+        vectors=put(_pad_stack(vecs, (D, dims), np.float32)).astype(jnp.bfloat16),
+        norms=put(_pad_stack(norms, (D,), np.float32)),
+        exists=put(_pad_stack(exists, (D,), bool)),
+        live=put(_pad_stack(live_np, (D,), bool)),
+        n_shards=S,
+        max_docs=D,
+        similarity=sim,
+    )
+
+
+def _put_sharded(arr: np.ndarray, mesh: Mesh | None):
+    """Place a [S, ...] stacked array with dim 0 sharded over the 'shard' axis."""
+    if mesh is None:
+        return jnp.asarray(arr)
+    return jax.device_put(arr, NamedSharding(mesh, P("shard")))
+
+
+# --------------------------------------------------------------------------
+# Host-side query preparation
+# --------------------------------------------------------------------------
+
+
+def prepare_query_blocks(
+    stacked: StackedBM25,
+    queries: List[List[str]],
+    bucket: int | None = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Map term lists to per-(query, shard) padded block ids + idf weights.
+
+    Returns (qblocks [Q, S, Bq] i32, qidf [Q, S, Bq] f32). Padding rows use
+    block 0 (all-zero) with idf 0. idf is computed from GLOBAL stats so every
+    shard scores consistently (ref P5 DFS_QUERY_THEN_FETCH semantics, here
+    free because stats are host metadata).
+    """
+    S = stacked.n_shards
+    Q = len(queries)
+    per_qs: List[List[Tuple[np.ndarray, float]]] = []
+    max_blocks = 1
+    # global df per term
+    for terms in queries:
+        rows: List[Tuple[np.ndarray, float]] = []
+        for term in terms:
+            df = sum(int(fp.doc_freq[fp.term_to_ord[term]]) if term in fp.term_to_ord else 0
+                     for fp in stacked.postings)
+            if df == 0:
+                continue
+            idf = bm25_idf(stacked.total_docs, df)
+            rows.append((term, idf))
+        per_qs.append(rows)
+        # count max blocks over shards
+        for s in range(S):
+            nb = sum(len(stacked.postings[s].term_block_ids(t)) for t, _ in rows)
+            max_blocks = max(max_blocks, nb)
+    Bq = bucket or next_bucket(max_blocks)
+    qblocks = np.zeros((Q, S, Bq), np.int32)
+    qidf = np.zeros((Q, S, Bq), np.float32)
+    for qi, rows in enumerate(per_qs):
+        for s in range(S):
+            fp = stacked.postings[s]
+            off = 0
+            for term, idf in rows:
+                ids = fp.term_block_ids(term)
+                n = len(ids)
+                if n == 0:
+                    continue
+                qblocks[qi, s, off: off + n] = ids
+                qidf[qi, s, off: off + n] = idf
+                off += n
+    return qblocks, qidf
+
+
+# --------------------------------------------------------------------------
+# The compiled SPMD programs
+# --------------------------------------------------------------------------
+
+
+def _local_bm25_topk(block_docs, block_tfs, doc_len, live, qblocks, qidf, avgdl, k):
+    """Per-device: score this shard for its query slice, local top-k.
+
+    block_docs [T,128], doc_len [D], live [D], qblocks [Q,B], qidf [Q,B].
+    Returns (scores [Q,k], ords [Q,k]).
+    """
+    D = doc_len.shape[0]
+
+    def one_query(qb, qi):
+        docs = jnp.take(block_docs, qb, axis=0)          # [B, 128]
+        tfs = jnp.take(block_tfs, qb, axis=0)
+        dl = jnp.take(doc_len, docs, axis=0)
+        denom = tfs + K1 * (1.0 - B + B * dl / avgdl)
+        sc = qi[:, None] * tfs * (K1 + 1.0) / denom
+        dense = jnp.zeros((D,), jnp.float32).at[docs.ravel()].add(sc.ravel())
+        dense = jnp.where(live & (dense > 0), dense, -jnp.inf)
+        return jax.lax.top_k(dense, k)
+
+    return jax.vmap(one_query)(qblocks, qidf)
+
+
+def _merge_gathered(scores_g, ords_g, k):
+    """[S, Q, k] gathered results -> per-query global top-k with provenance."""
+    S, Q, _ = scores_g.shape
+    flat_s = jnp.transpose(scores_g, (1, 0, 2)).reshape(Q, S * k)
+    flat_o = jnp.transpose(ords_g, (1, 0, 2)).reshape(Q, S * k)
+    top_s, idx = jax.lax.top_k(flat_s, k)                # [Q, k]
+    shard_of = (idx // k).astype(jnp.int32)
+    ord_of = jnp.take_along_axis(flat_o, idx, axis=1)
+    return top_s, shard_of, ord_of
+
+
+def sharded_bm25_topk(
+    mesh: Mesh,
+    stacked: StackedBM25,
+    qblocks: np.ndarray,   # [Q, S, Bq]
+    qidf: np.ndarray,      # [Q, S, Bq]
+    k: int = 10,
+):
+    """The flagship distributed program: batched BM25 over the mesh.
+
+    Queries shard over 'dp', the corpus shards over 'shard'; each device
+    scores its (query-slice x shard) tile, local top-k, all_gather over
+    'shard', device-side merge. Returns host arrays
+    (scores [Q,k], shard_idx [Q,k], ord [Q,k]).
+    """
+    avgdl = jnp.float32(max(stacked.avgdl, 1e-9))
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                  P("dp", "shard"), P("dp", "shard")),
+        out_specs=(P("dp"), P("dp"), P("dp")),
+        check_vma=False,
+    )
+    def program(block_docs, block_tfs, doc_len, live, qb, qi):
+        # local shapes: block_docs [1,T,128]; qb [Qd, 1, B]
+        s_scores, s_ords = _local_bm25_topk(
+            block_docs[0], block_tfs[0], doc_len[0], live[0], qb[:, 0], qi[:, 0], avgdl, k)
+        g_scores = jax.lax.all_gather(s_scores, "shard")   # [S, Qd, k]
+        g_ords = jax.lax.all_gather(s_ords, "shard")
+        top_s, shard_of, ord_of = _merge_gathered(g_scores, g_ords, k)
+        return top_s, shard_of, ord_of
+
+    top_s, shard_of, ord_of = jax.jit(program)(
+        stacked.block_docs, stacked.block_tfs, stacked.doc_len, stacked.live,
+        jnp.asarray(qblocks), jnp.asarray(qidf),
+    )
+    return np.asarray(top_s), np.asarray(shard_of), np.asarray(ord_of)
+
+
+def sharded_knn_topk(
+    mesh: Mesh,
+    stacked: StackedKnn,
+    queries: np.ndarray,   # [Q, dims] f32
+    k: int = 10,
+):
+    """Distributed brute-force kNN: local matmul + top-k, gather, merge."""
+    similarity = stacked.similarity
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("dp")),
+        out_specs=(P("dp"), P("dp"), P("dp")),
+        check_vma=False,
+    )
+    def program(vectors, norms, exists, live, q):
+        v = vectors[0]                                     # [D, dims] bf16
+        dots = jax.lax.dot_general(
+            q.astype(jnp.bfloat16), v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [Qd, D]
+        if similarity == "cosine":
+            qn = jnp.linalg.norm(q, axis=-1, keepdims=True)
+            sc = (1.0 + dots / jnp.maximum(qn * norms[0][None, :], 1e-20)) / 2.0
+        elif similarity == "dot_product":
+            sc = (1.0 + dots) / 2.0
+        else:  # l2_norm
+            qq = jnp.sum(q * q, axis=-1, keepdims=True)
+            dd = (norms[0] * norms[0])[None, :]
+            sc = 1.0 / (1.0 + jnp.sqrt(jnp.maximum(qq + dd - 2.0 * dots, 0.0)))
+        ok = exists[0] & live[0]
+        sc = jnp.where(ok[None, :], sc, -jnp.inf)
+        s_scores, s_ords = jax.lax.top_k(sc, k)            # [Qd, k]
+        g_scores = jax.lax.all_gather(s_scores, "shard")
+        g_ords = jax.lax.all_gather(s_ords, "shard")
+        return _merge_gathered(g_scores, g_ords, k)
+
+    top_s, shard_of, ord_of = jax.jit(program)(
+        stacked.vectors, stacked.norms, stacked.exists, stacked.live,
+        jnp.asarray(queries, jnp.float32),
+    )
+    return np.asarray(top_s), np.asarray(shard_of), np.asarray(ord_of)
