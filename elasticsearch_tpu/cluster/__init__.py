@@ -1,0 +1,3 @@
+from elasticsearch_tpu.cluster.state import ClusterState, IndexMetadata, DiscoveryNode
+
+__all__ = ["ClusterState", "IndexMetadata", "DiscoveryNode"]

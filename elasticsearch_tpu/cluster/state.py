@@ -1,0 +1,162 @@
+"""Immutable cluster state model.
+
+Re-designs the reference's ClusterState/Metadata/IndexMetadata/RoutingTable
+(ref: cluster/ClusterState.java, cluster/metadata/Metadata.java:1609,
+IndexMetadata.java, cluster/routing/RoutingTable.java) as frozen dataclasses
+with copy-on-write updaters. State changes go through a single-threaded
+master task queue (cluster/service/MasterService.java analog lives in
+cluster/coordination.py) and are versioned; appliers react to diffs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.settings import Settings
+
+
+@dataclass(frozen=True)
+class DiscoveryNode:
+    node_id: str
+    name: str
+    address: str = "127.0.0.1:9300"
+    roles: tuple = ("master", "data", "ingest")
+
+
+@dataclass(frozen=True)
+class ShardRouting:
+    """Ref: cluster/routing/ShardRouting.java — one shard copy's assignment."""
+
+    index: str
+    shard_id: int
+    node_id: Optional[str]
+    primary: bool
+    state: str = "STARTED"     # UNASSIGNED | INITIALIZING | STARTED | RELOCATING
+    allocation_id: str = ""
+
+
+@dataclass(frozen=True)
+class IndexMetadata:
+    index: str
+    uuid: str
+    settings: Settings
+    mappings: dict
+    aliases: Dict[str, dict] = field(default_factory=dict)
+    state: str = "open"
+    creation_date: int = field(default_factory=lambda: int(time.time() * 1000))
+    version: int = 1
+
+    @property
+    def number_of_shards(self) -> int:
+        return int(self.settings.raw("index.number_of_shards", 1))
+
+    @property
+    def number_of_replicas(self) -> int:
+        return int(self.settings.raw("index.number_of_replicas", 1))
+
+
+@dataclass(frozen=True)
+class ClusterState:
+    cluster_name: str = "elasticsearch-tpu"
+    version: int = 0
+    term: int = 0
+    master_node_id: Optional[str] = None
+    nodes: Dict[str, DiscoveryNode] = field(default_factory=dict)
+    indices: Dict[str, IndexMetadata] = field(default_factory=dict)
+    routing: Dict[str, List[ShardRouting]] = field(default_factory=dict)
+
+    # ---- functional updaters ----
+
+    def with_index(self, meta: IndexMetadata, routing: List[ShardRouting]) -> "ClusterState":
+        indices = dict(self.indices)
+        indices[meta.index] = meta
+        rt = dict(self.routing)
+        rt[meta.index] = routing
+        return replace(self, version=self.version + 1, indices=indices, routing=rt)
+
+    def without_index(self, index: str) -> "ClusterState":
+        indices = dict(self.indices)
+        indices.pop(index, None)
+        rt = dict(self.routing)
+        rt.pop(index, None)
+        return replace(self, version=self.version + 1, indices=indices, routing=rt)
+
+    def with_node(self, node: DiscoveryNode) -> "ClusterState":
+        nodes = dict(self.nodes)
+        nodes[node.node_id] = node
+        return replace(self, version=self.version + 1, nodes=nodes)
+
+    def resolve_indices(self, expression: str) -> List[str]:
+        """Index-name expression resolution: names, aliases, wildcards, _all
+        (ref: cluster/metadata/IndexNameExpressionResolver.java)."""
+        import fnmatch
+
+        if expression in ("_all", "*", ""):
+            return sorted(self.indices)
+        out: List[str] = []
+        for part in expression.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            matched = False
+            if "*" in part or "?" in part:
+                for name in sorted(self.indices):
+                    if fnmatch.fnmatchcase(name, part) and name not in out:
+                        out.append(name)
+                        matched = True
+                if not matched:
+                    matched = True  # wildcard with no match is not an error
+            else:
+                if part in self.indices:
+                    out.append(part)
+                    matched = True
+                else:
+                    for name, meta in self.indices.items():
+                        if part in meta.aliases and name not in out:
+                            out.append(name)
+                            matched = True
+        return out
+
+    def health(self) -> dict:
+        """Ref: cluster health computation — green/yellow/red from routing."""
+        active_primary = 0
+        active = 0
+        unassigned = 0
+        initializing = 0
+        for shards in self.routing.values():
+            for s in shards:
+                if s.state == "STARTED":
+                    active += 1
+                    if s.primary:
+                        active_primary += 1
+                elif s.state == "INITIALIZING":
+                    initializing += 1
+                else:
+                    unassigned += 1
+        if any(s.primary and s.state != "STARTED"
+               for shards in self.routing.values() for s in shards):
+            status = "red"
+        elif unassigned or initializing:
+            status = "yellow"
+        else:
+            status = "green"
+        total = active + unassigned + initializing
+        return {
+            "cluster_name": self.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": len(self.nodes),
+            "number_of_data_nodes": sum(1 for n in self.nodes.values() if "data" in n.roles),
+            "active_primary_shards": active_primary,
+            "active_shards": active,
+            "relocating_shards": 0,
+            "initializing_shards": initializing,
+            "unassigned_shards": unassigned,
+            "delayed_unassigned_shards": 0,
+            "number_of_pending_tasks": 0,
+            "number_of_in_flight_fetch": 0,
+            "task_max_waiting_in_queue_millis": 0,
+            "active_shards_percent_as_number": (100.0 * active / total) if total else 100.0,
+        }

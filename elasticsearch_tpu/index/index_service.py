@@ -1,0 +1,235 @@
+"""IndexService / IndicesService: per-index shard management.
+
+Re-designs the reference pair (ref: index/IndexModule.java:390
+newIndexService, indices/IndicesService.java:538 createIndex,
+index/shard/IndexShard.java): an IndexService owns N shard engines plus the
+shared mapper and analysis registry; IndicesService is the node-level
+registry creating/removing them from cluster-state metadata.
+
+Search across shards is scatter-gather (ref P3): per-shard query phases merge
+at the coordinator. Default stats scope is shard-local like the reference's
+query_then_fetch; search_type=dfs_query_then_fetch combines term stats
+across shards first (ref P5: SearchDfsQueryThenFetchAsyncAction).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingError,
+    IndexNotFoundError,
+    ResourceAlreadyExistsError,
+)
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.cluster.state import IndexMetadata, ShardRouting
+from elasticsearch_tpu.index.engine import EngineResult, InternalEngine
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.parallel.routing import shard_for_id
+from elasticsearch_tpu.search.executor import QueryExecutor, ShardStats
+from elasticsearch_tpu.search.fetch_phase import execute_fetch_phase
+from elasticsearch_tpu.search.query_phase import execute_query_phase
+
+
+class IndexService:
+    def __init__(self, meta: IndexMetadata, data_path: Optional[str] = None):
+        self.meta = meta
+        self.name = meta.index
+        analyzer_settings = meta.settings.raw("analysis")  # rarely set flat; see below
+        nested = meta.settings.filtered_by_prefix("index.analysis.analyzer.")
+        self.analysis = AnalysisRegistry(_analyzer_config(meta))
+        self.mapper = MapperService(meta.mappings, self.analysis)
+        self.shards: List[InternalEngine] = []
+        durability = meta.settings.raw("index.translog.durability", "request")
+        for shard_id in range(meta.number_of_shards):
+            path = os.path.join(data_path, self.name, str(shard_id)) if data_path else None
+            self.shards.append(
+                InternalEngine(self.mapper, data_path=path, translog_durability=durability)
+            )
+
+    # ---- document ops ----
+
+    def shard_for(self, doc_id: str, routing: str | None = None) -> InternalEngine:
+        return self.shards[shard_for_id(doc_id, len(self.shards), routing)]
+
+    def index_doc(self, doc_id: str, source: dict, **kw) -> EngineResult:
+        return self.shard_for(doc_id, kw.pop("routing", None)).index(doc_id, source, **kw)
+
+    def delete_doc(self, doc_id: str, **kw) -> EngineResult:
+        return self.shard_for(doc_id, kw.pop("routing", None)).delete(doc_id, **kw)
+
+    def get_doc(self, doc_id: str, routing: str | None = None) -> Optional[dict]:
+        return self.shard_for(doc_id, routing).get(doc_id)
+
+    def refresh(self) -> None:
+        for s in self.shards:
+            s.refresh()
+
+    def flush(self) -> None:
+        for s in self.shards:
+            s.flush()
+
+    def force_merge(self, max_num_segments: int = 1) -> None:
+        for s in self.shards:
+            s.force_merge(max_num_segments)
+
+    def doc_count(self) -> int:
+        return sum(s.doc_count() for s in self.shards)
+
+    def close(self) -> None:
+        for s in self.shards:
+            s.close()
+
+    # ---- search (scatter-gather across shards) ----
+
+    def search(self, request: dict, search_type: str = "query_then_fetch") -> dict:
+        import time as _time
+
+        from elasticsearch_tpu.search.query_phase import QuerySearchResult, _sort_key, parse_sort
+
+        start = _time.monotonic()
+        searchers = [s.acquire_searcher() for s in self.shards]
+
+        global_stats = None
+        if search_type == "dfs_query_then_fetch":
+            all_views = [v for se in searchers for v in se.views]
+            global_stats = ShardStats(all_views)
+
+        size = int(request.get("size", 10))
+        from_ = int(request.get("from", 0))
+        sort = parse_sort(request.get("sort"))
+
+        shard_results: List[QuerySearchResult] = []
+        per_shard_hits = []
+        for shard_id, searcher in enumerate(searchers):
+            ex = None
+            if global_stats is not None:
+                ex = QueryExecutor(self.mapper, global_stats)
+            qr = execute_query_phase(searcher, self.mapper, request, executor=ex)
+            shard_results.append(qr)
+            for h in qr.hits:
+                per_shard_hits.append((shard_id, h))
+
+        total = sum(r.total for r in shard_results)
+        relation = "gte" if any(r.relation == "gte" for r in shard_results) else "eq"
+        if sort:
+            per_shard_hits.sort(key=lambda t: _sort_key(t[1], sort))
+        else:
+            per_shard_hits.sort(key=lambda t: (-t[1].score, t[0], t[1].global_ord))
+        window = per_shard_hits[from_: from_ + size]
+
+        max_score = None
+        if not sort:
+            ms = [r.max_score for r in shard_results if r.max_score is not None]
+            if ms:
+                max_score = max(ms)
+
+        hits = []
+        for shard_id, h in window:
+            fetched = execute_fetch_phase(searchers[shard_id], [h], request, self.name)
+            hit = fetched[0]
+            if hit.get("_score") is None and h.sort_values is None:
+                hit["_score"] = h.score
+            hits.append(hit)
+
+        aggs = _merge_shard_aggs(shard_results)
+        took = int((_time.monotonic() - start) * 1000)
+        resp = {
+            "took": took,
+            "timed_out": False,
+            "_shards": {"total": len(self.shards), "successful": len(self.shards),
+                        "skipped": 0, "failed": 0},
+            "hits": {
+                "total": {"value": total, "relation": relation},
+                "max_score": max_score,
+                "hits": hits,
+            },
+        }
+        if aggs is not None:
+            resp["aggregations"] = aggs
+        return resp
+
+    def stats(self) -> dict:
+        total_segments = sum(s.segment_count() for s in self.shards)
+        return {
+            "docs": {"count": self.doc_count(), "deleted": 0},
+            "segments": {"count": total_segments},
+            "store": {"size_in_bytes": sum(
+                sum(seg.ram_bytes() for seg in s._segments) for s in self.shards)},
+        }
+
+
+def _merge_shard_aggs(shard_results) -> Optional[dict]:
+    """Commutative partial reduce of per-shard aggregation results
+    (ref P6: QueryPhaseResultConsumer batched reduce). Wired when the
+    aggregation phase lands; None-safe until then."""
+    parts = [r.aggregations for r in shard_results if r.aggregations is not None]
+    if not parts:
+        return None
+    from elasticsearch_tpu.search.aggregations import reduce_aggregations
+
+    return reduce_aggregations(parts)
+
+
+def _analyzer_config(meta: IndexMetadata) -> dict:
+    """Extract index.analysis.analyzer.<name>.* settings into registry config."""
+    nested = meta.settings.as_nested_dict()
+    try:
+        return nested["index"]["analysis"]["analyzer"]
+    except (KeyError, TypeError):
+        return {}
+
+
+class IndicesService:
+    """Node-level index registry (ref: indices/IndicesService.java:168)."""
+
+    def __init__(self, data_path: Optional[str] = None):
+        self.data_path = data_path
+        self._indices: Dict[str, IndexService] = {}
+        self._lock = threading.Lock()
+
+    def create_index(self, name: str, settings: Settings, mappings: dict,
+                     aliases: Dict[str, dict] | None = None) -> IndexMetadata:
+        with self._lock:
+            if name in self._indices:
+                raise ResourceAlreadyExistsError(f"index [{name}] already exists", index=name)
+            meta = IndexMetadata(
+                index=name,
+                uuid=uuid.uuid4().hex[:20],
+                settings=settings,
+                mappings=mappings or {},
+                aliases=aliases or {},
+            )
+            self._indices[name] = IndexService(meta, self.data_path)
+            return meta
+
+    def delete_index(self, name: str) -> None:
+        with self._lock:
+            svc = self._indices.pop(name, None)
+            if svc is None:
+                raise IndexNotFoundError(name)
+            svc.close()
+            if self.data_path:
+                import shutil
+
+                shutil.rmtree(os.path.join(self.data_path, name), ignore_errors=True)
+
+    def get(self, name: str) -> IndexService:
+        svc = self._indices.get(name)
+        if svc is None:
+            raise IndexNotFoundError(name)
+        return svc
+
+    def has(self, name: str) -> bool:
+        return name in self._indices
+
+    def names(self) -> List[str]:
+        return sorted(self._indices)
+
+    def close(self) -> None:
+        for svc in self._indices.values():
+            svc.close()

@@ -1,0 +1,96 @@
+"""Node: the composition root.
+
+Re-designs the reference's Node wiring (ref: node/Node.java:278 constructor,
+:776 start()) minus the DI ceremony: a Node owns the cluster state, the
+indices service, the transport action registry, and the REST controller.
+Single-node operation is complete; multi-node control plane attaches via
+transport.bind() (the coordination layer registers its own actions).
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, Optional
+
+from elasticsearch_tpu import __version__
+from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode, IndexMetadata, ShardRouting
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.breaker import HierarchyCircuitBreakerService
+from elasticsearch_tpu.index.index_service import IndicesService
+from elasticsearch_tpu.transport.service import TransportService
+
+
+class Node:
+    def __init__(self, settings: Settings | None = None, data_path: Optional[str] = None,
+                 node_name: str = "node-0"):
+        self.settings = settings or Settings.EMPTY
+        self.node_id = uuid.uuid4().hex[:20]
+        self.node_name = node_name
+        self._state_lock = threading.Lock()
+        node = DiscoveryNode(node_id=self.node_id, name=node_name)
+        self.cluster_state = ClusterState(
+            cluster_name=str(self.settings.raw("cluster.name", "elasticsearch-tpu")),
+            master_node_id=self.node_id,
+            nodes={self.node_id: node},
+        )
+        self.indices = IndicesService(data_path)
+        self.transport = TransportService(self.node_id)
+        self.breakers = HierarchyCircuitBreakerService()
+        self._register_actions()
+
+    # ---- cluster-state updates (single-threaded master semantics,
+    #      ref: cluster/service/MasterService.java) ----
+
+    def update_state(self, updater) -> ClusterState:
+        with self._state_lock:
+            self.cluster_state = updater(self.cluster_state)
+            return self.cluster_state
+
+    # ---- index lifecycle ----
+
+    def create_index(self, name: str, body: dict | None = None) -> IndexMetadata:
+        body = body or {}
+        settings = Settings(body.get("settings", {}))
+        if settings.raw("index.number_of_shards") is None and settings.raw("number_of_shards") is not None:
+            settings = settings.with_updates({"index.number_of_shards": settings.raw("number_of_shards")})
+        if settings.raw("index.number_of_replicas") is None and settings.raw("number_of_replicas") is not None:
+            settings = settings.with_updates({"index.number_of_replicas": settings.raw("number_of_replicas")})
+        mappings = body.get("mappings", {})
+        aliases = body.get("aliases", {})
+        meta = self.indices.create_index(name, settings, mappings, aliases)
+        routing = []
+        for shard_id in range(meta.number_of_shards):
+            routing.append(ShardRouting(index=name, shard_id=shard_id, node_id=self.node_id,
+                                        primary=True, state="STARTED",
+                                        allocation_id=uuid.uuid4().hex[:20]))
+            for _ in range(meta.number_of_replicas):
+                routing.append(ShardRouting(index=name, shard_id=shard_id, node_id=None,
+                                            primary=False, state="UNASSIGNED"))
+        self.update_state(lambda s: s.with_index(meta, routing))
+        return meta
+
+    def delete_index(self, name: str) -> None:
+        self.indices.delete_index(name)
+        self.update_state(lambda s: s.without_index(name))
+
+    # ---- transport actions (ref: action/ActionModule.java names) ----
+
+    def _register_actions(self) -> None:
+        t = self.transport
+        t.register_request_handler(
+            "cluster:monitor/health", lambda req: self.cluster_state.health())
+        t.register_request_handler(
+            "indices:data/read/search",
+            lambda req: self.indices.get(req.payload["index"]).search(
+                req.payload.get("body", {}), req.payload.get("search_type", "query_then_fetch")))
+        t.register_request_handler(
+            "indices:data/read/get",
+            lambda req: self.indices.get(req.payload["index"]).get_doc(req.payload["id"]) or {})
+        t.register_request_handler(
+            "indices:admin/refresh",
+            lambda req: (self.indices.get(req.payload["index"]).refresh(), {"ok": True})[1])
+
+    def close(self) -> None:
+        self.indices.close()
+        self.transport.close()

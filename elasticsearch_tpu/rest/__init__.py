@@ -1,0 +1,5 @@
+from elasticsearch_tpu.rest.controller import RestController, RestRequest, RestResponse
+from elasticsearch_tpu.rest.handlers import register_handlers
+from elasticsearch_tpu.rest.http_server import HttpServer
+
+__all__ = ["RestController", "RestRequest", "RestResponse", "register_handlers", "HttpServer"]

@@ -1,0 +1,132 @@
+"""REST dispatch: method+path-pattern routing to handlers.
+
+Re-designs the reference RestController's path trie
+(ref: rest/RestController.java:153 registerHandler — patterns like
+"/{index}/_search") with the same placeholder syntax. Handlers receive a
+RestRequest (params from placeholders + query string, parsed JSON body) and
+return a RestResponse. Exceptions map to ES-shaped error bodies with the
+status from the error class (ref: ElasticsearchException.status()).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+
+@dataclass
+class RestRequest:
+    method: str
+    path: str
+    params: Dict[str, str] = field(default_factory=dict)
+    body: Any = None
+    raw_body: bytes = b""
+
+    def param(self, name: str, default=None):
+        return self.params.get(name, default)
+
+    def param_bool(self, name: str, default: bool = False) -> bool:
+        v = self.params.get(name)
+        if v is None:
+            return default
+        return str(v).lower() in ("true", "1", "")
+
+    def param_int(self, name: str, default: int = 0) -> int:
+        v = self.params.get(name)
+        return default if v is None else int(v)
+
+
+@dataclass
+class RestResponse:
+    status: int = 200
+    body: Any = None
+    content_type: str = "application/json"
+
+    def encode(self) -> bytes:
+        if isinstance(self.body, (bytes,)):
+            return self.body
+        if isinstance(self.body, str):
+            return self.body.encode()
+        return json.dumps(self.body).encode()
+
+
+Handler = Callable[[RestRequest], RestResponse]
+
+
+class _Route:
+    __slots__ = ("segments", "handler")
+
+    def __init__(self, pattern: str, handler: Handler):
+        self.segments = [s for s in pattern.split("/") if s]
+        self.handler = handler
+
+    def match(self, parts: List[str]) -> Optional[Dict[str, str]]:
+        if len(parts) != len(self.segments):
+            return None
+        params: Dict[str, str] = {}
+        for seg, part in zip(self.segments, parts):
+            if seg.startswith("{") and seg.endswith("}"):
+                params[seg[1:-1]] = part
+            elif seg != part:
+                return None
+        return params
+
+    @property
+    def specificity(self) -> tuple:
+        # literal segments beat placeholders position-by-position
+        return tuple(0 if s.startswith("{") else 1 for s in self.segments)
+
+
+class RestController:
+    def __init__(self):
+        self._routes: Dict[str, List[_Route]] = {}
+
+    def register(self, method: str, pattern: str, handler: Handler) -> None:
+        self._routes.setdefault(method.upper(), []).append(_Route(pattern, handler))
+        self._routes[method.upper()].sort(key=lambda r: r.specificity, reverse=True)
+
+    def dispatch(self, method: str, path: str, params: Dict[str, str] | None = None,
+                 body: bytes | str | None = None) -> RestResponse:
+        parts = [p for p in path.split("?")[0].split("/") if p]
+        routes = self._routes.get(method.upper(), [])
+        for route in routes:
+            matched = route.match(parts)
+            if matched is not None:
+                req_params = dict(params or {})
+                req_params.update(matched)
+                parsed, raw = _parse_body(body)
+                req = RestRequest(method=method.upper(), path=path, params=req_params,
+                                  body=parsed, raw_body=raw)
+                try:
+                    return route.handler(req)
+                except ElasticsearchTpuError as e:
+                    return RestResponse(status=e.status, body=_error_body(e))
+                except Exception as e:  # noqa: BLE001 — REST boundary
+                    err = ElasticsearchTpuError(str(e))
+                    return RestResponse(status=500, body=_error_body(err))
+        if method.upper() == "HEAD":
+            return RestResponse(status=404, body={})
+        return RestResponse(
+            status=400,
+            body={"error": f"no handler found for uri [{path}] and method [{method.upper()}]"},
+        )
+
+
+def _parse_body(body) -> Tuple[Any, bytes]:
+    if body is None:
+        return None, b""
+    raw = body.encode() if isinstance(body, str) else body
+    if not raw.strip():
+        return None, raw
+    try:
+        return json.loads(raw), raw
+    except json.JSONDecodeError:
+        return None, raw  # ndjson bodies (bulk/msearch) parse downstream
+
+
+def _error_body(e: ElasticsearchTpuError) -> dict:
+    cause = e.to_dict()
+    return {"error": {"root_cause": [cause], **cause}, "status": e.status}

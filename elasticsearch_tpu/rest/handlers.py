@@ -1,0 +1,735 @@
+"""REST API handlers: the user-facing surface.
+
+Implements the core of the reference's REST API (ref: the 138 Rest*Action
+handlers under rest/action/ and the 144 specs in
+rest-api-spec/src/main/resources/rest-api-spec/api/): document CRUD, bulk,
+search/msearch/count, index admin, cluster/cat/nodes monitoring, analyze,
+mget, update, delete-by-query, aliases. Response shapes follow the reference
+so existing clients can switch over.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List
+
+from elasticsearch_tpu import __version__
+from elasticsearch_tpu.common.errors import (
+    DocumentMissingError,
+    ElasticsearchTpuError,
+    IllegalArgumentError,
+    IndexNotFoundError,
+    ParsingError,
+)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.controller import RestController, RestRequest, RestResponse
+from elasticsearch_tpu.search.queries import parse_query
+
+_START_TIME = time.time()
+
+
+def register_handlers(node: Node, rc: RestController) -> None:
+    h = _Handlers(node)
+    r = rc.register
+
+    r("GET", "/", h.root)
+    # index admin
+    r("PUT", "/{index}", h.create_index)
+    r("DELETE", "/{index}", h.delete_index)
+    r("GET", "/{index}", h.get_index)
+    r("HEAD", "/{index}", h.head_index)
+    r("GET", "/{index}/_mapping", h.get_mapping)
+    r("PUT", "/{index}/_mapping", h.put_mapping)
+    r("GET", "/{index}/_settings", h.get_settings)
+    r("POST", "/{index}/_refresh", h.refresh)
+    r("GET", "/{index}/_refresh", h.refresh)
+    r("POST", "/_refresh", h.refresh_all)
+    r("POST", "/{index}/_flush", h.flush)
+    r("POST", "/_flush", h.flush_all)
+    r("POST", "/{index}/_forcemerge", h.forcemerge)
+    r("GET", "/{index}/_stats", h.index_stats)
+    r("GET", "/_stats", h.all_stats)
+    r("GET", "/{index}/_count", h.count)
+    r("POST", "/{index}/_count", h.count)
+    r("GET", "/_count", h.count_all)
+    r("POST", "/_count", h.count_all)
+    # documents
+    r("PUT", "/{index}/_doc/{id}", h.index_doc)
+    r("POST", "/{index}/_doc/{id}", h.index_doc)
+    r("POST", "/{index}/_doc", h.index_doc_auto_id)
+    r("PUT", "/{index}/_create/{id}", h.create_doc)
+    r("POST", "/{index}/_create/{id}", h.create_doc)
+    r("GET", "/{index}/_doc/{id}", h.get_doc)
+    r("HEAD", "/{index}/_doc/{id}", h.head_doc)
+    r("GET", "/{index}/_source/{id}", h.get_source)
+    r("DELETE", "/{index}/_doc/{id}", h.delete_doc)
+    r("POST", "/{index}/_update/{id}", h.update_doc)
+    r("GET", "/_mget", h.mget)
+    r("POST", "/_mget", h.mget)
+    r("GET", "/{index}/_mget", h.mget)
+    r("POST", "/{index}/_mget", h.mget)
+    # bulk
+    r("POST", "/_bulk", h.bulk)
+    r("PUT", "/_bulk", h.bulk)
+    r("POST", "/{index}/_bulk", h.bulk)
+    # search
+    r("GET", "/{index}/_search", h.search)
+    r("POST", "/{index}/_search", h.search)
+    r("GET", "/_search", h.search_all)
+    r("POST", "/_search", h.search_all)
+    r("POST", "/_msearch", h.msearch)
+    r("GET", "/_msearch", h.msearch)
+    r("POST", "/{index}/_msearch", h.msearch)
+    r("POST", "/{index}/_delete_by_query", h.delete_by_query)
+    r("POST", "/{index}/_update_by_query", h.update_by_query)
+    # analyze
+    r("GET", "/_analyze", h.analyze)
+    r("POST", "/_analyze", h.analyze)
+    r("GET", "/{index}/_analyze", h.analyze)
+    r("POST", "/{index}/_analyze", h.analyze)
+    # cluster / monitoring
+    r("GET", "/_cluster/health", h.cluster_health)
+    r("GET", "/_cluster/state", h.cluster_state)
+    r("GET", "/_cluster/stats", h.cluster_stats)
+    r("GET", "/_nodes", h.nodes_info)
+    r("GET", "/_nodes/stats", h.nodes_stats)
+    # aliases
+    r("POST", "/_aliases", h.update_aliases)
+    r("GET", "/_alias", h.get_aliases)
+    r("GET", "/{index}/_alias", h.get_aliases)
+    # cat
+    r("GET", "/_cat/indices", h.cat_indices)
+    r("GET", "/_cat/health", h.cat_health)
+    r("GET", "/_cat/shards", h.cat_shards)
+    r("GET", "/_cat/count", h.cat_count)
+    r("GET", "/_cat/nodes", h.cat_nodes)
+
+
+def _ok(body, status=200) -> RestResponse:
+    return RestResponse(status=status, body=body)
+
+
+class _Handlers:
+    def __init__(self, node: Node):
+        self.node = node
+
+    # ---------- info ----------
+
+    def root(self, req: RestRequest) -> RestResponse:
+        return _ok({
+            "name": self.node.node_name,
+            "cluster_name": self.node.cluster_state.cluster_name,
+            "cluster_uuid": self.node.node_id,
+            "version": {
+                "number": __version__,
+                "build_flavor": "tpu",
+                "lucene_version": "none (tpu-native segments)",
+            },
+            "tagline": "You Know, for Search",
+        })
+
+    # ---------- index admin ----------
+
+    def create_index(self, req: RestRequest) -> RestResponse:
+        name = req.param("index")
+        meta = self.node.create_index(name, req.body or {})
+        return _ok({"acknowledged": True, "shards_acknowledged": True, "index": name})
+
+    def delete_index(self, req: RestRequest) -> RestResponse:
+        for name in self._resolve(req.param("index"), require=True):
+            self.node.delete_index(name)
+        return _ok({"acknowledged": True})
+
+    def get_index(self, req: RestRequest) -> RestResponse:
+        out = {}
+        for name in self._resolve(req.param("index"), require=True):
+            svc = self.node.indices.get(name)
+            meta = self.node.cluster_state.indices[name]
+            out[name] = {
+                "aliases": meta.aliases,
+                "mappings": svc.mapper.mapping(),
+                "settings": {"index": {
+                    "number_of_shards": str(meta.number_of_shards),
+                    "number_of_replicas": str(meta.number_of_replicas),
+                    "uuid": meta.uuid,
+                    "creation_date": str(meta.creation_date),
+                    "provided_name": name,
+                }},
+            }
+        return _ok(out)
+
+    def head_index(self, req: RestRequest) -> RestResponse:
+        exists = all(self.node.indices.has(n) for n in
+                     self._resolve(req.param("index"))) and \
+            bool(self._resolve(req.param("index")))
+        return RestResponse(status=200 if exists else 404, body={})
+
+    def get_mapping(self, req: RestRequest) -> RestResponse:
+        out = {}
+        for name in self._resolve(req.param("index"), require=True):
+            out[name] = {"mappings": self.node.indices.get(name).mapper.mapping()}
+        return _ok(out)
+
+    def put_mapping(self, req: RestRequest) -> RestResponse:
+        for name in self._resolve(req.param("index"), require=True):
+            self.node.indices.get(name).mapper.merge(req.body or {})
+        return _ok({"acknowledged": True})
+
+    def get_settings(self, req: RestRequest) -> RestResponse:
+        out = {}
+        for name in self._resolve(req.param("index"), require=True):
+            meta = self.node.cluster_state.indices[name]
+            out[name] = {"settings": {"index": {
+                "number_of_shards": str(meta.number_of_shards),
+                "number_of_replicas": str(meta.number_of_replicas),
+                "uuid": meta.uuid,
+            }}}
+        return _ok(out)
+
+    def refresh(self, req: RestRequest) -> RestResponse:
+        names = self._resolve(req.param("index"), require=True)
+        for name in names:
+            self.node.indices.get(name).refresh()
+        n = sum(len(self.node.indices.get(x).shards) for x in names)
+        return _ok({"_shards": {"total": n, "successful": n, "failed": 0}})
+
+    def refresh_all(self, req: RestRequest) -> RestResponse:
+        req.params["index"] = "_all"
+        return self.refresh(req)
+
+    def flush(self, req: RestRequest) -> RestResponse:
+        names = self._resolve(req.param("index"), require=True)
+        for name in names:
+            self.node.indices.get(name).flush()
+        n = sum(len(self.node.indices.get(x).shards) for x in names)
+        return _ok({"_shards": {"total": n, "successful": n, "failed": 0}})
+
+    def flush_all(self, req: RestRequest) -> RestResponse:
+        req.params["index"] = "_all"
+        return self.flush(req)
+
+    def forcemerge(self, req: RestRequest) -> RestResponse:
+        max_segs = req.param_int("max_num_segments", 1)
+        for name in self._resolve(req.param("index"), require=True):
+            self.node.indices.get(name).force_merge(max_segs)
+        return _ok({"_shards": {"total": 1, "successful": 1, "failed": 0}})
+
+    def index_stats(self, req: RestRequest) -> RestResponse:
+        out = {"indices": {}}
+        total = {"docs": {"count": 0, "deleted": 0}, "store": {"size_in_bytes": 0}}
+        for name in self._resolve(req.param("index"), require=True):
+            stats = self.node.indices.get(name).stats()
+            out["indices"][name] = {"primaries": stats, "total": stats}
+            total["docs"]["count"] += stats["docs"]["count"]
+            total["store"]["size_in_bytes"] += stats["store"]["size_in_bytes"]
+        out["_all"] = {"primaries": total, "total": total}
+        return _ok(out)
+
+    def all_stats(self, req: RestRequest) -> RestResponse:
+        req.params["index"] = "_all"
+        return self.index_stats(req)
+
+    # ---------- documents ----------
+
+    def index_doc(self, req: RestRequest) -> RestResponse:
+        return self._do_index(req, req.param("id"), op_type=req.param("op_type", "index"))
+
+    def index_doc_auto_id(self, req: RestRequest) -> RestResponse:
+        import uuid as _uuid
+
+        return self._do_index(req, _uuid.uuid4().hex[:20], op_type="create")
+
+    def create_doc(self, req: RestRequest) -> RestResponse:
+        return self._do_index(req, req.param("id"), op_type="create")
+
+    def _do_index(self, req: RestRequest, doc_id: str, op_type: str) -> RestResponse:
+        name = req.param("index")
+        if not self.node.indices.has(name):
+            self.node.create_index(name, {})  # auto-create (ref: TransportBulkAction)
+        svc = self.node.indices.get(name)
+        kw = {}
+        if req.param("if_seq_no") is not None:
+            kw["if_seq_no"] = req.param_int("if_seq_no")
+            kw["if_primary_term"] = req.param_int("if_primary_term")
+        result = svc.index_doc(doc_id, req.body or {}, op_type=op_type, **kw)
+        if req.param("refresh") in ("true", "", "wait_for"):
+            svc.refresh()
+        status = 201 if result.result == "created" else 200
+        return _ok(self._write_response(name, result), status)
+
+    def _write_response(self, index: str, result) -> dict:
+        return {
+            "_index": index,
+            "_id": result.doc_id,
+            "_version": result.version,
+            "result": result.result,
+            "_shards": {"total": 1, "successful": 1, "failed": 0},
+            "_seq_no": result.seq_no,
+            "_primary_term": result.primary_term,
+        }
+
+    def get_doc(self, req: RestRequest) -> RestResponse:
+        svc = self.node.indices.get(req.param("index"))
+        doc = svc.get_doc(req.param("id"), routing=req.param("routing"))
+        if doc is None:
+            return _ok({"_index": req.param("index"), "_id": req.param("id"), "found": False}, 404)
+        out = {"_index": req.param("index"), **doc, "found": True}
+        return _ok(out)
+
+    def head_doc(self, req: RestRequest) -> RestResponse:
+        svc = self.node.indices.get(req.param("index"))
+        doc = svc.get_doc(req.param("id"))
+        return RestResponse(status=200 if doc else 404, body={})
+
+    def get_source(self, req: RestRequest) -> RestResponse:
+        svc = self.node.indices.get(req.param("index"))
+        doc = svc.get_doc(req.param("id"))
+        if doc is None:
+            raise DocumentMissingError(f"[{req.param('id')}]: document missing")
+        return _ok(doc["_source"])
+
+    def delete_doc(self, req: RestRequest) -> RestResponse:
+        name = req.param("index")
+        svc = self.node.indices.get(name)
+        kw = {}
+        if req.param("if_seq_no") is not None:
+            kw["if_seq_no"] = req.param_int("if_seq_no")
+            kw["if_primary_term"] = req.param_int("if_primary_term")
+        result = svc.delete_doc(req.param("id"), **kw)
+        if req.param("refresh") in ("true", "", "wait_for"):
+            svc.refresh()
+        status = 200 if result.result == "deleted" else 404
+        return _ok(self._write_response(name, result), status)
+
+    def update_doc(self, req: RestRequest) -> RestResponse:
+        """Partial update: doc merge + doc_as_upsert/upsert
+        (ref: action/update/UpdateHelper.java)."""
+        name = req.param("index")
+        svc = self.node.indices.get(name)
+        doc_id = req.param("id")
+        body = req.body or {}
+        existing = svc.get_doc(doc_id)
+        if existing is None:
+            if body.get("doc_as_upsert") and "doc" in body:
+                source = body["doc"]
+            elif "upsert" in body:
+                source = body["upsert"]
+            else:
+                raise DocumentMissingError(f"[{doc_id}]: document missing")
+            result = svc.index_doc(doc_id, source)
+        else:
+            if "doc" not in body:
+                raise IllegalArgumentError("failed to parse update request: expected [doc]")
+            merged = _deep_merge(dict(existing["_source"]), body["doc"])
+            if merged == existing["_source"] and not body.get("detect_noop") is False:
+                return _ok({
+                    "_index": name, "_id": doc_id, "_version": existing["_version"],
+                    "result": "noop",
+                    "_shards": {"total": 0, "successful": 0, "failed": 0},
+                    "_seq_no": existing["_seq_no"], "_primary_term": existing["_primary_term"],
+                })
+            result = svc.index_doc(doc_id, merged)
+        if req.param("refresh") in ("true", "", "wait_for"):
+            svc.refresh()
+        return _ok(self._write_response(name, result))
+
+    def mget(self, req: RestRequest) -> RestResponse:
+        body = req.body or {}
+        docs_spec = body.get("docs")
+        if docs_spec is None and "ids" in body:
+            docs_spec = [{"_id": i, "_index": req.param("index")} for i in body["ids"]]
+        out = []
+        for spec in docs_spec or []:
+            index = spec.get("_index", req.param("index"))
+            doc_id = spec["_id"]
+            try:
+                svc = self.node.indices.get(index)
+                doc = svc.get_doc(doc_id)
+            except IndexNotFoundError:
+                doc = None
+            if doc is None:
+                out.append({"_index": index, "_id": doc_id, "found": False})
+            else:
+                out.append({"_index": index, **doc, "found": True})
+        return _ok({"docs": out})
+
+    # ---------- bulk ----------
+
+    def bulk(self, req: RestRequest) -> RestResponse:
+        """NDJSON bulk (ref: action/bulk/TransportBulkAction.java:164)."""
+        default_index = req.param("index")
+        lines = [ln for ln in req.raw_body.decode("utf-8").split("\n") if ln.strip()]
+        items: List[dict] = []
+        errors = False
+        start = time.monotonic()
+        i = 0
+        touched = set()
+        while i < len(lines):
+            try:
+                action_line = json.loads(lines[i])
+            except json.JSONDecodeError:
+                raise ParsingError(f"Malformed action/metadata line [{i + 1}]")
+            if len(action_line) != 1:
+                raise ParsingError(f"Malformed action/metadata line [{i + 1}]")
+            op, meta = next(iter(action_line.items()))
+            index = meta.get("_index", default_index)
+            doc_id = meta.get("_id")
+            i += 1
+            source = None
+            if op in ("index", "create", "update"):
+                if i >= len(lines):
+                    raise ParsingError("Validation Failed: missing source for bulk op")
+                source = json.loads(lines[i])
+                i += 1
+            try:
+                if not self.node.indices.has(index):
+                    self.node.create_index(index, {})
+                svc = self.node.indices.get(index)
+                touched.add(index)
+                if op in ("index", "create"):
+                    if doc_id is None:
+                        import uuid as _uuid
+
+                        doc_id = _uuid.uuid4().hex[:20]
+                    result = svc.index_doc(doc_id, source,
+                                           op_type="create" if op == "create" else "index")
+                    items.append({op: {**self._write_response(index, result),
+                                       "status": 201 if result.result == "created" else 200}})
+                elif op == "delete":
+                    result = svc.delete_doc(doc_id)
+                    items.append({op: {**self._write_response(index, result),
+                                       "status": 200 if result.result == "deleted" else 404}})
+                elif op == "update":
+                    sub = RestRequest("POST", "", {"index": index, "id": doc_id}, source)
+                    resp = self.update_doc(sub)
+                    items.append({op: {**resp.body, "status": resp.status}})
+                else:
+                    raise ParsingError(f"Malformed action [{op}]")
+            except ElasticsearchTpuError as e:
+                errors = True
+                items.append({op: {"_index": index, "_id": doc_id, "status": e.status,
+                                   "error": e.to_dict()}})
+        if req.param("refresh") in ("true", "", "wait_for"):
+            for name in touched:
+                self.node.indices.get(name).refresh()
+        took = int((time.monotonic() - start) * 1000)
+        return _ok({"took": took, "errors": errors, "items": items})
+
+    # ---------- search ----------
+
+    def search(self, req: RestRequest) -> RestResponse:
+        names = self._resolve(req.param("index"), require=True)
+        body = dict(req.body or {})
+        # url params mirror body fields (ref: RestSearchAction)
+        if req.param("q") is not None:
+            body["query"] = {"match": {"_all": req.param("q")}}  # minimal q= support
+        for p in ("size", "from"):
+            if req.param(p) is not None:
+                body[p] = req.param_int(p)
+        search_type = req.param("search_type", "query_then_fetch")
+        if len(names) == 1:
+            return _ok(self.node.indices.get(names[0]).search(body, search_type))
+        return _ok(self._multi_index_search(names, body, search_type))
+
+    def search_all(self, req: RestRequest) -> RestResponse:
+        req.params.setdefault("index", "_all")
+        return self.search(req)
+
+    def _multi_index_search(self, names: List[str], body: dict, search_type: str) -> dict:
+        responses = [(n, self.node.indices.get(n).search(body, search_type)) for n in names]
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        all_hits = []
+        total = 0
+        max_score = None
+        shards_total = 0
+        for name, r in responses:
+            total += r["hits"]["total"]["value"]
+            shards_total += r["_shards"]["total"]
+            if r["hits"]["max_score"] is not None:
+                max_score = max(max_score or float("-inf"), r["hits"]["max_score"])
+            all_hits.extend(r["hits"]["hits"])
+        if body.get("sort"):
+            all_hits.sort(key=lambda h: h.get("sort", []))
+        else:
+            all_hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        return {
+            "took": sum(r["took"] for _, r in responses),
+            "timed_out": False,
+            "_shards": {"total": shards_total, "successful": shards_total,
+                        "skipped": 0, "failed": 0},
+            "hits": {"total": {"value": total, "relation": "eq"},
+                     "max_score": max_score,
+                     "hits": all_hits[from_: from_ + size]},
+        }
+
+    def msearch(self, req: RestRequest) -> RestResponse:
+        lines = [ln for ln in req.raw_body.decode().split("\n") if ln.strip()]
+        responses = []
+        i = 0
+        while i + 1 <= len(lines) - 1 or (i < len(lines)):
+            header = json.loads(lines[i])
+            body = json.loads(lines[i + 1]) if i + 1 < len(lines) else {}
+            i += 2
+            index = header.get("index", req.param("index", "_all"))
+            try:
+                names = self._resolve(index, require=True)
+                if len(names) == 1:
+                    responses.append({**self.node.indices.get(names[0]).search(body), "status": 200})
+                else:
+                    responses.append({**self._multi_index_search(names, body, "query_then_fetch"),
+                                      "status": 200})
+            except ElasticsearchTpuError as e:
+                responses.append({"error": e.to_dict(), "status": e.status})
+        return _ok({"took": sum(r.get("took", 0) for r in responses), "responses": responses})
+
+    def count(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        body["size"] = 0
+        body["track_total_hits"] = True
+        names = self._resolve(req.param("index"), require=True)
+        total = 0
+        for n in names:
+            total += self.node.indices.get(n).search(body)["hits"]["total"]["value"]
+        return _ok({"count": total,
+                    "_shards": {"total": len(names), "successful": len(names),
+                                "skipped": 0, "failed": 0}})
+
+    def count_all(self, req: RestRequest) -> RestResponse:
+        req.params.setdefault("index", "_all")
+        return self.count(req)
+
+    def delete_by_query(self, req: RestRequest) -> RestResponse:
+        """Scroll-free delete-by-query (ref: reindex module's
+        DeleteByQueryRequest — client-side search+delete loop)."""
+        names = self._resolve(req.param("index"), require=True)
+        body = dict(req.body or {})
+        body["size"] = 10000
+        body["_source"] = False
+        deleted = 0
+        start = time.monotonic()
+        for n in names:
+            svc = self.node.indices.get(n)
+            svc.refresh()
+            r = svc.search(body)
+            for h in r["hits"]["hits"]:
+                result = svc.delete_doc(h["_id"])
+                if result.result == "deleted":
+                    deleted += 1
+            svc.refresh()
+        return _ok({"took": int((time.monotonic() - start) * 1000), "timed_out": False,
+                    "total": deleted, "deleted": deleted, "batches": 1,
+                    "version_conflicts": 0, "noops": 0, "failures": []})
+
+    def update_by_query(self, req: RestRequest) -> RestResponse:
+        """Re-indexes matching docs in place (no script support yet)."""
+        names = self._resolve(req.param("index"), require=True)
+        body = dict(req.body or {})
+        if "script" in body:
+            raise IllegalArgumentError("script in update_by_query is not yet supported")
+        body["size"] = 10000
+        updated = 0
+        start = time.monotonic()
+        for n in names:
+            svc = self.node.indices.get(n)
+            svc.refresh()
+            r = svc.search(body)
+            for h in r["hits"]["hits"]:
+                svc.index_doc(h["_id"], h["_source"])
+                updated += 1
+            svc.refresh()
+        return _ok({"took": int((time.monotonic() - start) * 1000), "timed_out": False,
+                    "total": updated, "updated": updated, "batches": 1,
+                    "version_conflicts": 0, "noops": 0, "failures": []})
+
+    # ---------- analyze ----------
+
+    def analyze(self, req: RestRequest) -> RestResponse:
+        body = req.body or {}
+        text = body.get("text", "")
+        texts = text if isinstance(text, list) else [text]
+        index = req.param("index")
+        if index and self.node.indices.has(index):
+            registry = self.node.indices.get(index).analysis
+            svc = self.node.indices.get(index)
+            if "field" in body:
+                ft = svc.mapper.field_type(body["field"])
+                analyzer = svc.mapper.analyzer_for(ft) if ft is not None else registry.get("standard")
+            else:
+                analyzer = registry.get(body.get("analyzer", "standard"))
+        else:
+            from elasticsearch_tpu.analysis import AnalysisRegistry
+
+            analyzer = AnalysisRegistry().get(body.get("analyzer", "standard"))
+        tokens = []
+        for i, t in enumerate(texts):
+            for tok in analyzer.tokenize(t):
+                tokens.append({
+                    "token": tok.term,
+                    "start_offset": tok.start_offset,
+                    "end_offset": tok.end_offset,
+                    "type": "<ALPHANUM>",
+                    "position": tok.position,
+                })
+        return _ok({"tokens": tokens})
+
+    # ---------- cluster / monitoring ----------
+
+    def cluster_health(self, req: RestRequest) -> RestResponse:
+        return _ok(self.node.cluster_state.health())
+
+    def cluster_state(self, req: RestRequest) -> RestResponse:
+        cs = self.node.cluster_state
+        return _ok({
+            "cluster_name": cs.cluster_name,
+            "cluster_uuid": self.node.node_id,
+            "version": cs.version,
+            "state_uuid": f"v{cs.version}",
+            "master_node": cs.master_node_id,
+            "nodes": {nid: {"name": n.name, "transport_address": n.address,
+                            "roles": list(n.roles)} for nid, n in cs.nodes.items()},
+            "metadata": {"indices": {
+                name: {"state": m.state, "settings": {"index": {
+                    "number_of_shards": str(m.number_of_shards),
+                    "number_of_replicas": str(m.number_of_replicas)}},
+                    "aliases": sorted(m.aliases)}
+                for name, m in cs.indices.items()}},
+        })
+
+    def cluster_stats(self, req: RestRequest) -> RestResponse:
+        total_docs = sum(self.node.indices.get(n).doc_count()
+                         for n in self.node.indices.names())
+        return _ok({
+            "cluster_name": self.node.cluster_state.cluster_name,
+            "status": self.node.cluster_state.health()["status"],
+            "indices": {"count": len(self.node.indices.names()),
+                        "docs": {"count": total_docs, "deleted": 0}},
+            "nodes": {"count": {"total": len(self.node.cluster_state.nodes)}},
+        })
+
+    def nodes_info(self, req: RestRequest) -> RestResponse:
+        import jax
+
+        cs = self.node.cluster_state
+        return _ok({
+            "_nodes": {"total": len(cs.nodes), "successful": len(cs.nodes), "failed": 0},
+            "cluster_name": cs.cluster_name,
+            "nodes": {nid: {
+                "name": n.name,
+                "transport_address": n.address,
+                "version": __version__,
+                "roles": list(n.roles),
+                "accelerators": [str(d) for d in jax.devices()],
+            } for nid, n in cs.nodes.items()},
+        })
+
+    def nodes_stats(self, req: RestRequest) -> RestResponse:
+        cs = self.node.cluster_state
+        return _ok({
+            "_nodes": {"total": len(cs.nodes), "successful": len(cs.nodes), "failed": 0},
+            "cluster_name": cs.cluster_name,
+            "nodes": {self.node.node_id: {
+                "name": self.node.node_name,
+                "indices": {"docs": {"count": sum(
+                    self.node.indices.get(n).doc_count() for n in self.node.indices.names())}},
+                "breakers": self.node.breakers.stats(),
+                "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
+            }},
+        })
+
+    # ---------- aliases ----------
+
+    def update_aliases(self, req: RestRequest) -> RestResponse:
+        from dataclasses import replace
+
+        for action in (req.body or {}).get("actions", []):
+            op, spec = next(iter(action.items()))
+            index = spec["index"]
+            alias = spec["alias"]
+            meta = self.node.cluster_state.indices.get(index)
+            if meta is None:
+                raise IndexNotFoundError(index)
+            aliases = dict(meta.aliases)
+            if op == "add":
+                aliases[alias] = {k: v for k, v in spec.items() if k not in ("index", "alias")}
+            elif op == "remove":
+                aliases.pop(alias, None)
+            else:
+                raise IllegalArgumentError(f"unsupported alias action [{op}]")
+            new_meta = replace(meta, aliases=aliases, version=meta.version + 1)
+            routing = self.node.cluster_state.routing[index]
+            self.node.update_state(lambda s: s.with_index(new_meta, routing))
+        return _ok({"acknowledged": True})
+
+    def get_aliases(self, req: RestRequest) -> RestResponse:
+        out = {}
+        for name in self._resolve(req.param("index", "_all"), require=False):
+            meta = self.node.cluster_state.indices[name]
+            out[name] = {"aliases": meta.aliases}
+        return _ok(out)
+
+    # ---------- cat ----------
+
+    def cat_indices(self, req: RestRequest) -> RestResponse:
+        rows = []
+        cs = self.node.cluster_state
+        for name in self.node.indices.names():
+            svc = self.node.indices.get(name)
+            meta = cs.indices[name]
+            health = "yellow" if meta.number_of_replicas > 0 else "green"
+            rows.append(f"{health} open {name} {meta.uuid} {meta.number_of_shards} "
+                        f"{meta.number_of_replicas} {svc.doc_count()} 0 0b 0b")
+        return RestResponse(body="\n".join(rows) + ("\n" if rows else ""),
+                            content_type="text/plain")
+
+    def cat_health(self, req: RestRequest) -> RestResponse:
+        h = self.node.cluster_state.health()
+        line = (f"{int(time.time())} {time.strftime('%H:%M:%S')} {h['cluster_name']} "
+                f"{h['status']} {h['number_of_nodes']} {h['number_of_data_nodes']} "
+                f"{h['active_shards']} {h['active_primary_shards']} 0 0 "
+                f"{h['unassigned_shards']} 0 - "
+                f"{h['active_shards_percent_as_number']:.1f}%\n")
+        return RestResponse(body=line, content_type="text/plain")
+
+    def cat_shards(self, req: RestRequest) -> RestResponse:
+        rows = []
+        for index, shards in self.node.cluster_state.routing.items():
+            if not self.node.indices.has(index):
+                continue
+            svc = self.node.indices.get(index)
+            for s in shards:
+                kind = "p" if s.primary else "r"
+                docs = svc.shards[s.shard_id].doc_count() if s.primary else 0
+                node = self.node.node_name if s.node_id else ""
+                rows.append(f"{index} {s.shard_id} {kind} {s.state} {docs} 0b "
+                            f"{'127.0.0.1' if s.node_id else ''} {node}")
+        return RestResponse(body="\n".join(rows) + ("\n" if rows else ""),
+                            content_type="text/plain")
+
+    def cat_count(self, req: RestRequest) -> RestResponse:
+        total = sum(self.node.indices.get(n).doc_count() for n in self.node.indices.names())
+        return RestResponse(body=f"{int(time.time())} {time.strftime('%H:%M:%S')} {total}\n",
+                            content_type="text/plain")
+
+    def cat_nodes(self, req: RestRequest) -> RestResponse:
+        rows = [f"127.0.0.1 0 0 - cdfhilmrstw * {self.node.node_name}"]
+        return RestResponse(body="\n".join(rows) + "\n", content_type="text/plain")
+
+    # ---------- helpers ----------
+
+    def _resolve(self, expression: str | None, require: bool = False) -> List[str]:
+        expression = expression or "_all"
+        names = self.node.cluster_state.resolve_indices(expression)
+        if require and not names and expression not in ("_all", "*"):
+            raise IndexNotFoundError(expression)
+        return names
+
+
+def _deep_merge(base: dict, patch: dict) -> dict:
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            base[k] = _deep_merge(dict(base[k]), v)
+        else:
+            base[k] = v
+    return base

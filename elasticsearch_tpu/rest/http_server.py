@@ -1,0 +1,57 @@
+"""HTTP frontend: stdlib threaded server hosting the RestController.
+
+The analog of the reference's Netty4HttpServerTransport
+(ref: http/AbstractHttpServerTransport.java:59, modules/transport-netty4) —
+the HTTP layer is deliberately thin: parse method/path/query/body, dispatch,
+encode. Heavy lifting (search execution) releases the GIL inside XLA, so a
+threaded server keeps the device busy under concurrent clients.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl, urlsplit
+
+from elasticsearch_tpu.rest.controller import RestController
+
+
+class HttpServer:
+    def __init__(self, controller: RestController, host: str = "127.0.0.1", port: int = 9200):
+        self.controller = controller
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _dispatch(self):
+                parts = urlsplit(self.path)
+                params = dict(parse_qsl(parts.query, keep_blank_values=True))
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else None
+                resp = outer.controller.dispatch(self.command, parts.path, params, body)
+                data = resp.encode()
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-elastic-product", "Elasticsearch")
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(data)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_HEAD = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+
+    def start(self) -> None:
+        t = threading.Thread(target=self._server.serve_forever, daemon=True)
+        t.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -1,0 +1,3 @@
+from elasticsearch_tpu.transport.service import TransportService, TransportRequest
+
+__all__ = ["TransportService", "TransportRequest"]
