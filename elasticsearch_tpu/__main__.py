@@ -1,0 +1,46 @@
+"""`python -m elasticsearch_tpu` — start a single node with the HTTP frontend.
+
+The analog of the reference's bin/elasticsearch -> Elasticsearch.main ->
+Bootstrap.init -> Node.start (ref: bootstrap/Elasticsearch.java:64,
+bootstrap/Bootstrap.java:327).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="elasticsearch-tpu")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9200)
+    ap.add_argument("--data", default=None, help="data path (translog/commits); in-memory if unset")
+    ap.add_argument("--name", default="node-0")
+    ap.add_argument("--cluster-name", default="elasticsearch-tpu")
+    args = ap.parse_args(argv)
+
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import HttpServer, RestController, register_handlers
+
+    node = Node(Settings({"cluster.name": args.cluster_name}),
+                data_path=args.data, node_name=args.name)
+    rc = RestController()
+    register_handlers(node, rc)
+    server = HttpServer(rc, host=args.host, port=args.port)
+    server.start()
+    print(f"[{args.name}] started, http on {args.host}:{server.port}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,3 +80,8 @@ class SearchPhaseExecutionError(ElasticsearchTpuError):
 class ShardNotFoundError(ElasticsearchTpuError):
     status = 404
     error_type = "shard_not_found_exception"
+
+
+class JsonParseError(ElasticsearchTpuError):
+    status = 400
+    error_type = "json_parse_exception"

@@ -14,7 +14,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError, JsonParseError
 
 
 @dataclass
@@ -97,7 +97,10 @@ class RestController:
             if matched is not None:
                 req_params = dict(params or {})
                 req_params.update(matched)
-                parsed, raw = _parse_body(body)
+                parsed, raw, parse_error = _parse_body(body)
+                if parse_error and not _is_ndjson_endpoint(parts):
+                    err = JsonParseError("request body is not valid JSON")
+                    return RestResponse(status=err.status, body=_error_body(err))
                 req = RestRequest(method=method.upper(), path=path, params=req_params,
                                   body=parsed, raw_body=raw)
                 try:
@@ -115,16 +118,21 @@ class RestController:
         )
 
 
-def _parse_body(body) -> Tuple[Any, bytes]:
+def _is_ndjson_endpoint(parts: List[str]) -> bool:
+    """bulk/_msearch bodies are newline-delimited JSON, parsed downstream."""
+    return any(p in ("_bulk", "_msearch") for p in parts)
+
+
+def _parse_body(body) -> Tuple[Any, bytes, bool]:
     if body is None:
-        return None, b""
+        return None, b"", False
     raw = body.encode() if isinstance(body, str) else body
     if not raw.strip():
-        return None, raw
+        return None, raw, False
     try:
-        return json.loads(raw), raw
+        return json.loads(raw), raw, False
     except json.JSONDecodeError:
-        return None, raw  # ndjson bodies (bulk/msearch) parse downstream
+        return None, raw, True
 
 
 def _error_body(e: ElasticsearchTpuError) -> dict:

@@ -142,3 +142,80 @@ def test_live_mask_excludes_deleted(sharded):
     top_s2, shard_of2, ord_of2 = sharded_bm25_topk(mesh, stacked2, qb, qi, k=3)
     ids2 = [segments[sh].doc_ids[o] for sh, o in zip(shard_of2[0], ord_of2[0])]
     assert best_id not in ids2
+
+
+def test_column_cache_matches_block_path(sharded):
+    from elasticsearch_tpu.parallel.spmd import Bm25ColumnCache
+
+    docs, engines, segments, single = sharded
+    mesh = make_mesh(4, dp=1)
+    stacked = build_stacked_bm25(segments, "body", mesh=mesh)
+    cache = Bm25ColumnCache(stacked, mesh, capacity=64)
+    queries = [["w0", "w3"], ["w1"], ["w5", "w9", "w21"], ["w2", "w40"]]
+
+    qb, qi = prepare_query_blocks(stacked, queries)
+    ref_s, ref_sh, ref_o = sharded_bm25_topk(mesh, stacked, qb, qi, k=10)
+    got_s, got_sh, got_o = cache.search(queries, k=10)
+    np.testing.assert_allclose(got_s, ref_s, rtol=1e-4)
+    finite = np.isfinite(ref_s)
+    assert (got_sh[finite] == ref_sh[finite]).mean() > 0.95
+
+    # second batch reuses cached columns (w0/w1 hot) + adds a cold term
+    queries2 = [["w0"], ["w1", "w60"]]
+    got2_s, got2_sh, got2_o = cache.search(queries2, k=5)
+    qb2, qi2 = prepare_query_blocks(stacked, queries2)
+    ref2_s, _, _ = sharded_bm25_topk(mesh, stacked, qb2, qi2, k=5)
+    np.testing.assert_allclose(got2_s, ref2_s, rtol=1e-4)
+
+
+def test_column_cache_eviction():
+    rng = np.random.default_rng(11)
+    docs = corpus(rng)
+    from elasticsearch_tpu.parallel.spmd import Bm25ColumnCache
+
+    engines = [InternalEngine(MapperService(dict(MAPPING))) for _ in range(2)]
+    for doc_id, src in docs.items():
+        engines[shard_for_id(doc_id, 2)].index(doc_id, src)
+    for e in engines:
+        e.refresh()
+    segments = [e.acquire_searcher().views[0].segment for e in engines]
+    mesh = make_mesh(2, dp=1)
+    stacked = build_stacked_bm25(segments, "body", mesh=mesh)
+    cache = Bm25ColumnCache(stacked, mesh, capacity=4)
+    cache.search([["w0", "w1"]], k=3)
+    cache.search([["w2", "w3"]], k=3)
+    s1, _, _ = cache.search([["w4", "w5"]], k=3)  # evicts w0/w1
+    assert len(cache.term_slot) <= 4
+    # re-query evicted terms: rebuilt correctly
+    qb, qi = prepare_query_blocks(stacked, [["w0", "w1"]])
+    ref_s, _, _ = sharded_bm25_topk(mesh, stacked, qb, qi, k=3)
+    got_s, _, _ = cache.search([["w0", "w1"]], k=3)
+    np.testing.assert_allclose(got_s, ref_s, rtol=1e-4)
+
+
+def test_column_cache_never_evicts_current_batch_terms():
+    rng = np.random.default_rng(12)
+    docs = corpus(rng)
+    from elasticsearch_tpu.parallel.spmd import Bm25ColumnCache
+
+    engine = InternalEngine(MapperService(dict(MAPPING)))
+    for doc_id, src in docs.items():
+        engine.index(doc_id, src)
+    engine.refresh()
+    seg = engine.acquire_searcher().views[0].segment
+    mesh = make_mesh(1, dp=1)
+    stacked = build_stacked_bm25([seg], "body", mesh=mesh)
+    cache = Bm25ColumnCache(stacked, mesh, capacity=4)
+    cache.search([["w0", "w1", "w2", "w3"]], k=3)
+    # batch mixes 3 hot terms + 1 cold at full capacity: w3 (stale) must be
+    # evicted, never the batch's own hot terms (regression: used to KeyError)
+    s, sh, o = cache.search([["w0", "w1", "w2", "w4"]], k=3)
+    assert set(cache.term_slot) == {"w0", "w1", "w2", "w4"}
+    qb, qi = prepare_query_blocks(stacked, [["w0", "w1", "w2", "w4"]])
+    ref_s, _, _ = sharded_bm25_topk(mesh, stacked, qb, qi, k=3)
+    np.testing.assert_allclose(s, ref_s, rtol=1e-4)
+    # a single batch needing more distinct terms than capacity cannot be
+    # made resident at once: explicit error, not a corrupt cache
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        cache.search([["w0", "w1", "w2"], ["w4", "w5"]], k=3)
