@@ -136,7 +136,7 @@ class IndexService:
                 hit["_score"] = h.score
             hits.append(hit)
 
-        aggs = _merge_shard_aggs(shard_results)
+        aggs = _merge_shard_aggs(request, shard_results)
         took = int((_time.monotonic() - start) * 1000)
         resp = {
             "took": took,
@@ -163,16 +163,16 @@ class IndexService:
         }
 
 
-def _merge_shard_aggs(shard_results) -> Optional[dict]:
-    """Commutative partial reduce of per-shard aggregation results
-    (ref P6: QueryPhaseResultConsumer batched reduce). Wired when the
-    aggregation phase lands; None-safe until then."""
+def _merge_shard_aggs(request, shard_results) -> Optional[dict]:
+    """Commutative partial reduce of per-shard aggregation partials, then
+    finalize once at the coordinator (ref P6: QueryPhaseResultConsumer
+    batched reduce + SearchPhaseController final reduce)."""
     parts = [r.aggregations for r in shard_results if r.aggregations is not None]
     if not parts:
         return None
-    from elasticsearch_tpu.search.aggregations import reduce_aggregations
+    from elasticsearch_tpu.search.aggregations import finalize_shard_aggs
 
-    return reduce_aggregations(parts)
+    return finalize_shard_aggs(request, parts)
 
 
 def _analyzer_config(meta: IndexMetadata) -> dict:

@@ -1,0 +1,3 @@
+from elasticsearch_tpu.script.expressions import ExpressionScript, compile_script
+
+__all__ = ["ExpressionScript", "compile_script"]

@@ -39,6 +39,7 @@ class QuerySearchResult:
     relation: str                      # "eq" | "gte"
     hits: List[ShardHit]
     max_score: Optional[float]
+    # reduced aggregation PARTIALS for this shard (coordinator finalizes)
     aggregations: Optional[dict] = None
 
 
@@ -97,8 +98,11 @@ def execute_query_phase(
         if k == from_ + size:
             k = max(k, knn_query.k)
 
+    aggs_spec = request.get("aggs") or request.get("aggregations")
+
     total = 0
     collected: List[ShardHit] = []
+    leaf_masks: List[np.ndarray] = []
 
     # knn contributes only the k nearest live docs shard-wide (ref: ES 8 knn
     # section semantics — per-shard top-k then coordinator merge)
@@ -140,6 +144,8 @@ def execute_query_phase(
         if min_score is not None:
             mask = mask & (scores >= float(min_score))
         total += int(jnp.sum(mask.astype(jnp.int32)))
+        if aggs_spec:
+            leaf_masks.append((leaf, np.asarray(mask)))
 
         if sort:
             collected.extend(_collect_sorted(leaf, leaf_idx, scores, mask, sort, k))
@@ -177,7 +183,24 @@ def execute_query_phase(
     elif track is False:
         relation = "gte"
 
-    return QuerySearchResult(total=total, relation=relation, hits=window, max_score=max_score)
+    agg_partials = None
+    if aggs_spec:
+        from elasticsearch_tpu.search.aggregations import (
+            AggContext, collect_leaf, parse_aggs, reduce_partials,
+        )
+
+        aggs, _ = parse_aggs(aggs_spec)
+        partials = [
+            collect_leaf(aggs, AggContext(leaf=leaf, mapper=mapper, executor=ex,
+                                          live=np.asarray(leaf.live_dev())), m)
+            for leaf, m in leaf_masks
+        ]
+        # reduce leaves within the shard; the coordinator reduces shards and
+        # finalizes (ref P6: partials stay commutative until the final reduce)
+        agg_partials = reduce_partials(aggs, partials)
+
+    return QuerySearchResult(total=total, relation=relation, hits=window,
+                             max_score=max_score, aggregations=agg_partials)
 
 
 def _collect_sorted(leaf: LeafContext, leaf_idx: int, scores, mask, sort, k) -> List[ShardHit]:

@@ -39,5 +39,7 @@ def execute_search(
         },
     }
     if qr.aggregations is not None:
-        resp["aggregations"] = qr.aggregations
+        from elasticsearch_tpu.search.aggregations import finalize_shard_aggs
+
+        resp["aggregations"] = finalize_shard_aggs(request, [qr.aggregations])
     return resp

@@ -1,0 +1,314 @@
+"""Aggregation framework: collect/reduce/finalize parity with expected values."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.search import execute_search
+
+MAPPING = {
+    "properties": {
+        "category": {"type": "keyword"},
+        "tags": {"type": "keyword"},
+        "price": {"type": "double"},
+        "qty": {"type": "integer"},
+        "sold_at": {"type": "date"},
+        "body": {"type": "text"},
+    }
+}
+
+DOCS = [
+    {"category": "a", "tags": ["x", "y"], "price": 10.0, "qty": 1,
+     "sold_at": "2021-01-01T00:00:00Z", "body": "alpha beta"},
+    {"category": "a", "tags": ["x"], "price": 20.0, "qty": 2,
+     "sold_at": "2021-01-01T06:00:00Z", "body": "alpha"},
+    {"category": "b", "tags": ["y"], "price": 30.0, "qty": 3,
+     "sold_at": "2021-01-02T00:00:00Z", "body": "beta"},
+    {"category": "b", "tags": ["z"], "price": 40.0, "qty": 4,
+     "sold_at": "2021-01-02T12:00:00Z", "body": "gamma"},
+    {"category": "c", "tags": [], "price": 50.0, "qty": 5,
+     "sold_at": "2021-01-03T00:00:00Z", "body": "alpha gamma"},
+]
+
+
+@pytest.fixture(scope="module")
+def engine():
+    e = InternalEngine(MapperService(dict(MAPPING)))
+    for i, d in enumerate(DOCS):
+        e.index(str(i), d)
+    e.refresh()
+    return e
+
+
+def search(engine, body):
+    return execute_search(engine.acquire_searcher(), engine.mapper, body, "idx")
+
+
+def test_metric_aggs(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "mn": {"min": {"field": "price"}},
+        "mx": {"max": {"field": "price"}},
+        "sm": {"sum": {"field": "price"}},
+        "av": {"avg": {"field": "price"}},
+        "vc": {"value_count": {"field": "price"}},
+        "st": {"stats": {"field": "price"}},
+        "es": {"extended_stats": {"field": "price"}},
+    }})
+    a = r["aggregations"]
+    assert a["mn"]["value"] == 10.0
+    assert a["mx"]["value"] == 50.0
+    assert a["sm"]["value"] == 150.0
+    assert a["av"]["value"] == 30.0
+    assert a["vc"]["value"] == 5
+    assert a["st"] == {"count": 5, "min": 10.0, "max": 50.0, "avg": 30.0, "sum": 150.0}
+    assert a["es"]["variance"] == pytest.approx(200.0)
+    assert a["es"]["std_deviation"] == pytest.approx(np.sqrt(200.0))
+
+
+def test_terms_agg_with_sub(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "cats": {"terms": {"field": "category"},
+                 "aggs": {"avg_price": {"avg": {"field": "price"}}}}}})
+    buckets = r["aggregations"]["cats"]["buckets"]
+    by_key = {b["key"]: b for b in buckets}
+    assert by_key["a"]["doc_count"] == 2
+    assert by_key["a"]["avg_price"]["value"] == 15.0
+    assert by_key["b"]["doc_count"] == 2
+    assert by_key["c"]["avg_price"]["value"] == 50.0
+    # default order: count desc
+    assert buckets[0]["doc_count"] >= buckets[-1]["doc_count"]
+
+
+def test_terms_multivalued_and_order_by_subagg(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "tags": {"terms": {"field": "tags", "order": {"avg_p": "desc"}},
+                 "aggs": {"avg_p": {"avg": {"field": "price"}}}}}})
+    buckets = r["aggregations"]["tags"]["buckets"]
+    by_key = {b["key"]: b for b in buckets}
+    assert by_key["x"]["doc_count"] == 2
+    assert by_key["y"]["doc_count"] == 2
+    assert by_key["z"]["doc_count"] == 1
+    # z avg=40, y avg=20, x avg=15
+    assert [b["key"] for b in buckets] == ["z", "y", "x"]
+
+
+def test_terms_agg_respects_query(engine):
+    r = search(engine, {"size": 0, "query": {"range": {"price": {"gte": 25}}},
+                        "aggs": {"cats": {"terms": {"field": "category"}}}})
+    by_key = {b["key"]: b for b in r["aggregations"]["cats"]["buckets"]}
+    assert "a" not in by_key
+    assert by_key["b"]["doc_count"] == 2
+
+
+def test_histogram_and_range(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "h": {"histogram": {"field": "price", "interval": 20}},
+        "r": {"range": {"field": "price",
+                        "ranges": [{"to": 25}, {"from": 25, "to": 45}, {"from": 45}]}},
+    }})
+    h = r["aggregations"]["h"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in h] == [(0.0, 1), (20.0, 2), (40.0, 2)]
+    rb = r["aggregations"]["r"]["buckets"]
+    assert [b["doc_count"] for b in rb] == [2, 2, 1]
+    assert rb[0]["to"] == 25.0 and rb[1]["from"] == 25.0
+
+
+def test_date_histogram(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "d": {"date_histogram": {"field": "sold_at", "calendar_interval": "day"}}}})
+    buckets = r["aggregations"]["d"]["buckets"]
+    assert [b["doc_count"] for b in buckets] == [2, 2, 1]
+    assert buckets[0]["key_as_string"].startswith("2021-01-01")
+
+
+def test_filter_filters_missing_global(engine):
+    r = search(engine, {"size": 0, "query": {"term": {"category": "a"}}, "aggs": {
+        "expensive": {"filter": {"range": {"price": {"gte": 15}}}},
+        "byf": {"filters": {"filters": {"cheap": {"range": {"price": {"lt": 15}}},
+                                        "rich": {"range": {"price": {"gte": 15}}}}}},
+        "no_tags": {"missing": {"field": "tags"}},
+        "all": {"global": {}, "aggs": {"mx": {"max": {"field": "price"}}}},
+    }})
+    a = r["aggregations"]
+    assert a["expensive"]["doc_count"] == 1
+    assert a["byf"]["buckets"]["cheap"]["doc_count"] == 1
+    assert a["byf"]["buckets"]["rich"]["doc_count"] == 1
+    assert a["no_tags"]["doc_count"] == 0   # both 'a' docs have tags
+    assert a["all"]["doc_count"] == 5       # global ignores the query
+    assert a["all"]["mx"]["value"] == 50.0
+
+
+def test_cardinality_and_percentiles(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "card": {"cardinality": {"field": "category"}},
+        "card_n": {"cardinality": {"field": "qty"}},
+        "pct": {"percentiles": {"field": "price", "percents": [50.0]}},
+        "ranks": {"percentile_ranks": {"field": "price", "values": [30.0]}},
+    }})
+    a = r["aggregations"]
+    assert a["card"]["value"] == 3
+    assert a["card_n"]["value"] == 5
+    assert a["pct"]["values"]["50.0"] == pytest.approx(30.0, rel=0.2)
+    assert a["ranks"]["values"]["30.0"] == pytest.approx(50.0, abs=15)
+
+
+def test_top_hits_and_weighted_avg(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "cats": {"terms": {"field": "category"},
+                 "aggs": {"top": {"top_hits": {"size": 1}}}},
+        "wavg": {"weighted_avg": {"value": {"field": "price"},
+                                  "weight": {"field": "qty"}}},
+    }})
+    a = r["aggregations"]
+    by_key = {b["key"]: b for b in a["cats"]["buckets"]}
+    assert by_key["a"]["top"]["hits"]["total"]["value"] == 2
+    assert len(by_key["a"]["top"]["hits"]["hits"]) == 1
+    # (10*1+20*2+30*3+40*4+50*5)/(1+2+3+4+5) = 550/15
+    assert a["wavg"]["value"] == pytest.approx(550 / 15)
+
+
+def test_pipeline_aggs(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "days": {"date_histogram": {"field": "sold_at", "calendar_interval": "day"},
+                 "aggs": {"rev": {"sum": {"field": "price"}}}},
+        "total_rev": {"sum_bucket": {"buckets_path": "days>rev"}},
+        "avg_rev": {"avg_bucket": {"buckets_path": "days>rev"}},
+        "max_rev": {"max_bucket": {"buckets_path": "days>rev"}},
+        "cum": {"cumulative_sum": {"buckets_path": "days>rev"}},
+        "deriv": {"derivative": {"buckets_path": "days>rev"}},
+    }})
+    a = r["aggregations"]
+    # day sums: 30, 70, 50
+    assert a["total_rev"]["value"] == 150.0
+    assert a["avg_rev"]["value"] == 50.0
+    assert a["max_rev"]["value"] == 70.0
+    days = a["days"]["buckets"]
+    assert [b["cum"]["value"] for b in days] == [30.0, 100.0, 150.0]
+    assert days[0]["deriv"]["value"] is None
+    assert days[1]["deriv"]["value"] == 40.0
+
+
+def test_bucket_script_and_selector(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "cats": {"terms": {"field": "category"},
+                 "aggs": {"rev": {"sum": {"field": "price"}},
+                          "n": {"sum": {"field": "qty"}}}},
+        "per_unit": {"bucket_script": {
+            "buckets_path": {"r": "cats>rev", "n": "cats>n"},
+            "script": "r / n"}},
+    }})
+    # bucket_script applied per bucket of cats
+    buckets = r["aggregations"]["cats"]["buckets"]
+    by_key = {b["key"]: b for b in buckets}
+    assert by_key["a"]["per_unit"]["value"] == pytest.approx(30.0 / 3)
+
+
+def test_composite_agg(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "comp": {"composite": {"size": 2, "sources": [
+            {"cat": {"terms": {"field": "category"}}}]}}}})
+    comp = r["aggregations"]["comp"]
+    assert [b["key"]["cat"] for b in comp["buckets"]] == ["a", "b"]
+    assert comp["after_key"] == {"cat": "b"}
+    r2 = search(engine, {"size": 0, "aggs": {
+        "comp": {"composite": {"size": 2, "after": {"cat": "b"}, "sources": [
+            {"cat": {"terms": {"field": "category"}}}]}}}})
+    assert [b["key"]["cat"] for b in r2["aggregations"]["comp"]["buckets"]] == ["c"]
+
+
+def test_multi_segment_reduce(engine):
+    # fresh engine, two refreshes -> two segments; reduce must merge
+    e = InternalEngine(MapperService(dict(MAPPING)))
+    for i, d in enumerate(DOCS[:3]):
+        e.index(str(i), d)
+    e.refresh()
+    for i, d in enumerate(DOCS[3:], start=3):
+        e.index(str(i), d)
+    e.refresh()
+    r = search(e, {"size": 0, "aggs": {
+        "cats": {"terms": {"field": "category"}},
+        "st": {"stats": {"field": "price"}},
+        "card": {"cardinality": {"field": "category"}},
+    }})
+    a = r["aggregations"]
+    by_key = {b["key"]: b for b in a["cats"]["buckets"]}
+    assert by_key["b"]["doc_count"] == 2   # b spans both segments
+    assert a["st"]["count"] == 5 and a["st"]["sum"] == 150.0
+    assert a["card"]["value"] == 3
+
+
+def test_histogram_empty_bucket_fill(engine):
+    e = InternalEngine(MapperService(dict(MAPPING)))
+    e.index("1", {"price": 0.0})
+    e.index("2", {"price": 60.0})
+    e.refresh()
+    r = search(e, {"size": 0, "aggs": {
+        "h": {"histogram": {"field": "price", "interval": 20}}}})
+    buckets = r["aggregations"]["h"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in buckets] == [
+        (0.0, 1), (20.0, 0), (40.0, 0), (60.0, 1)]
+
+
+def test_parent_pipelines_declared_inside_bucket_agg(engine):
+    # the ES-idiomatic placement: derivative/cumsum INSIDE date_histogram
+    r = search(engine, {"size": 0, "aggs": {
+        "days": {"date_histogram": {"field": "sold_at", "calendar_interval": "day"},
+                 "aggs": {"rev": {"sum": {"field": "price"}},
+                          "d": {"derivative": {"buckets_path": "rev"}},
+                          "c": {"cumulative_sum": {"buckets_path": "rev"}}}}}})
+    days = r["aggregations"]["days"]["buckets"]
+    assert [b["c"]["value"] for b in days] == [30.0, 100.0, 150.0]
+    assert days[1]["d"]["value"] == 40.0
+
+
+def test_bucket_selector_inside_terms(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "cats": {"terms": {"field": "category"},
+                 "aggs": {"rev": {"sum": {"field": "price"}},
+                          "keep": {"bucket_selector": {
+                              "buckets_path": {"r": "rev"},
+                              "script": "r > 40"}}}}}})
+    keys = [b["key"] for b in r["aggregations"]["cats"]["buckets"]]
+    # revenues: a=30, b=70, c=50 -> keep b and c
+    assert sorted(keys) == ["b", "c"]
+
+
+def test_bucket_sort_inside_terms(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "cats": {"terms": {"field": "category"},
+                 "aggs": {"rev": {"sum": {"field": "price"}},
+                          "srt": {"bucket_sort": {
+                              "sort": [{"rev": {"order": "desc"}}], "size": 2}}}}}})
+    buckets = r["aggregations"]["cats"]["buckets"]
+    assert [b["key"] for b in buckets] == ["b", "c"]
+
+
+def test_median_absolute_deviation(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "mad": {"median_absolute_deviation": {"field": "price"}}}})
+    # prices 10..50, median 30, deviations [20,10,0,10,20] -> MAD ~10
+    assert r["aggregations"]["mad"]["value"] == pytest.approx(10.0, rel=0.5)
+
+
+def test_fractional_interval_histogram():
+    e = InternalEngine(MapperService(dict(MAPPING)))
+    e.index("1", {"price": 0.05})
+    e.index("2", {"price": 0.35})
+    e.refresh()
+    r = search(e, {"size": 0, "aggs": {
+        "h": {"histogram": {"field": "price", "interval": 0.1}}}})
+    buckets = r["aggregations"]["h"]["buckets"]
+    assert [(b["key"], b["doc_count"]) for b in buckets] == [
+        (0.0, 1), (0.1, 0), (0.2, 0), (0.3, 1)]
+
+
+def test_bucket_selector_with_params(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "cats": {"terms": {"field": "category"},
+                 "aggs": {"rev": {"sum": {"field": "price"}},
+                          "keep": {"bucket_selector": {
+                              "buckets_path": {"r": "rev"},
+                              "script": {"source": "r > params['lim']",
+                                         "params": {"lim": 40}}}}}}}})
+    assert sorted(b["key"] for b in r["aggregations"]["cats"]["buckets"]) == ["b", "c"]

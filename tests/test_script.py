@@ -1,0 +1,50 @@
+"""Sandboxed expression scripts: allowlist + contexts."""
+
+import pytest
+
+from elasticsearch_tpu.script import compile_script
+from elasticsearch_tpu.script.expressions import ScriptException, doc_map
+
+
+def test_arithmetic():
+    assert compile_script("1 + 2 * 3").execute() == 7
+    assert compile_script({"source": "max(a, b) / 2"}).execute({"a": 4, "b": 8}) == 4
+
+
+def test_painless_isms():
+    assert compile_script("a > 1 && b < 2").execute({"a": 2, "b": 1}) is True
+    assert compile_script("a != 1 || false").execute({"a": 1}) is False
+    assert compile_script("Math.log(1)").execute() == 0.0
+
+
+def test_doc_access():
+    env = {"doc": doc_map({"price": [10.0, 20.0], "empty_f": []})}
+    assert compile_script("doc['price'].value * 2").execute(env) == 20.0
+    assert compile_script("doc['price'].length").execute(env) == 2
+    with pytest.raises(ScriptException):
+        compile_script("doc['empty_f'].value").execute(env)
+
+
+def test_sandbox_rejects():
+    for bad in [
+        "__import__('os')",
+        "().__class__",
+        "open('/etc/passwd')",
+        "[x for x in (1,)]",
+        "lambda: 1",
+        "exec('1')",
+    ]:
+        with pytest.raises(ScriptException):
+            compile_script(bad).execute()
+
+
+def test_runtime_error_wrapped():
+    with pytest.raises(ScriptException):
+        compile_script("1 / 0").execute()
+
+
+def test_normalize_preserves_strings_and_identifiers():
+    env = {"doc": doc_map({"annulled": [3.0], "status": ["null"]})}
+    assert compile_script("doc['annulled'].value").execute(env) == 3.0
+    assert compile_script("doc['status'].value == 'null'").execute(env) is True
+    assert compile_script("nullable + 1").execute({"nullable": 1}) == 2
