@@ -52,6 +52,50 @@ _ALLOWED_NODES = (
 _ALLOWED_ATTRS = {"value", "values", "length", "empty"}
 
 
+def _safe_pow(a, b):
+    """Bounded exponentiation: painless-style compute limiting — an eval'd
+    expression cannot be interrupted, so astronomically-large powers are
+    rejected up front."""
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        if abs(b) > 1024 or (isinstance(a, int) and abs(a) > 1 and abs(b) > 256):
+            raise ScriptException("power operand too large")
+    return a ** b
+
+
+def _safe_mult(a, b):
+    """Bounded multiplication: rejects huge sequence repetition."""
+    for seq, n in ((a, b), (b, a)):
+        if isinstance(seq, (str, list, tuple)) and isinstance(n, int):
+            if len(seq) * max(n, 0) > 100_000:
+                raise ScriptException("sequence repetition too large")
+    return a * b
+
+
+class _GuardOps(ast.NodeTransformer):
+    """Rewrite Pow/Mult into guarded calls at compile time."""
+
+    _MAP = {ast.Pow: "__safe_pow__", ast.Mult: "__safe_mult__"}
+
+    def visit_BinOp(self, node):
+        self.generic_visit(node)
+        fname = self._MAP.get(type(node.op))
+        if fname is None:
+            return node
+        return ast.copy_location(
+            ast.Call(func=ast.Name(id=fname, ctx=ast.Load()),
+                     args=[node.left, node.right], keywords=[]), node)
+
+
+class _AttrDict(dict):
+    """params dict supporting both params['x'] and painless params.x."""
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise ScriptException(f"missing script parameter [{name}]") from None
+
+
 _STRING_RE = re.compile(r"'[^']*'|\"[^\"]*\"")
 
 
@@ -95,19 +139,26 @@ class ExpressionScript:
                 raise ScriptException(
                     f"illegal construct [{type(node).__name__}] in script [{source}]")
             if isinstance(node, ast.Attribute) and node.attr not in _ALLOWED_ATTRS:
-                raise ScriptException(
-                    f"unknown attribute [.{node.attr}] in script [{source}]")
+                # painless params.x is allowed; all other attributes are not
+                if not (isinstance(node.value, ast.Name) and node.value.id == "params"):
+                    raise ScriptException(
+                        f"unknown attribute [.{node.attr}] in script [{source}]")
             if isinstance(node, ast.Call):
                 if not isinstance(node.func, ast.Name) or node.func.id not in _ALLOWED_FUNCS:
                     raise ScriptException(
                         f"unknown function in script [{source}]")
+        tree = ast.fix_missing_locations(_GuardOps().visit(tree))
         self._code = compile(tree, "<script>", "eval")
 
     def execute(self, variables: Mapping[str, Any] | None = None) -> Any:
         env: Dict[str, Any] = dict(_ALLOWED_FUNCS)
         env["None"] = None
+        env["__safe_pow__"] = _safe_pow
+        env["__safe_mult__"] = _safe_mult
         if variables:
             env.update(variables)
+        if isinstance(env.get("params"), dict):
+            env["params"] = _AttrDict(env["params"])
         try:
             return eval(self._code, {"__builtins__": {}}, env)  # noqa: S307 — AST-allowlisted
         except ScriptException:

@@ -45,6 +45,7 @@ class AggContext:
     mapper: Any                     # MapperService
     executor: Any                   # QueryExecutor (for filter/filters aggs)
     live: np.ndarray                # [n_docs] bool — live docs irrespective of query
+    scores: Optional[np.ndarray] = None   # [n_docs] f32 query scores (top_hits)
 
 
 PIPELINE_TYPES = {
@@ -583,25 +584,67 @@ class MedianAbsoluteDeviationAgg(Agg):
 class TopHitsAgg(Agg):
     type_name = "top_hits"
 
+    def _sort_spec(self):
+        sort = self.params.get("sort")
+        if not sort:
+            return None
+        if isinstance(sort, (str, dict)):
+            sort = [sort]
+        out = []
+        for s in sort:
+            if isinstance(s, str):
+                out.append((s, "asc" if s != "_score" else "desc"))
+            else:
+                (f, spec), = s.items()
+                out.append((f, spec.get("order", "asc") if isinstance(spec, dict) else spec))
+        return out
+
     def collect(self, ctx, mask):
         size = int(self.params.get("size", 3))
         seg = ctx.leaf.segment
         sel = np.nonzero(mask)[0]
+        scores = ctx.scores if ctx.scores is not None else np.zeros(ctx.leaf.n_docs)
+        sort = self._sort_spec()
+        if sort:
+            fname, order = sort[0]
+            if fname == "_score":
+                keys = scores[sel]
+                desc = order == "desc"
+            else:
+                col = seg.numeric.get(fname)
+                keys = col.values[sel] if col is not None else np.zeros(len(sel))
+                desc = order == "desc"
+            order_idx = np.argsort(-keys if desc else keys, kind="stable")
+        else:
+            order_idx = np.argsort(-scores[sel], kind="stable")
         hits = []
-        for o in sel[:size * 4]:
-            hits.append({"_id": seg.doc_ids[o], "_score": 1.0,
-                         "_source": seg.sources[o]})
-        return {"hits": hits[:size], "total": int(mask.sum())}
+        for o in sel[order_idx[:size]]:
+            h = {"_id": seg.doc_ids[o], "_score": float(scores[o]),
+                 "_source": seg.sources[o]}
+            if sort:
+                h["sort"] = [float(scores[o]) if sort[0][0] == "_score"
+                             else (float(seg.numeric[sort[0][0]].values[o])
+                                   if sort[0][0] in seg.numeric else None)]
+            hits.append(h)
+        return {"hits": hits, "total": int(mask.sum()),
+                "sorted_by": sort[0] if sort else ("_score", "desc")}
 
     def reduce(self, partials):
         hits = [h for p in partials for h in p["hits"]]
-        return {"hits": hits, "total": sum(p["total"] for p in partials)}
+        sorted_by = partials[0]["sorted_by"] if partials else ("_score", "desc")
+        fname, order = sorted_by
+        key = (lambda h: h["sort"][0] if h.get("sort") and h["sort"][0] is not None
+               else 0) if fname != "_score" else (lambda h: h["_score"])
+        hits.sort(key=key, reverse=(order == "desc"))
+        return {"hits": hits, "total": sum(p["total"] for p in partials),
+                "sorted_by": sorted_by}
 
     def finalize(self, partial):
         size = int(self.params.get("size", 3))
         hits = partial["hits"][:size]
+        max_score = max((h["_score"] for h in hits), default=None)
         return {"hits": {"total": {"value": partial["total"], "relation": "eq"},
-                         "max_score": hits[0]["_score"] if hits else None,
+                         "max_score": max_score,
                          "hits": hits}}
 
 
@@ -689,11 +732,17 @@ class TermsAgg(BucketAgg):
                 "buckets": buckets}
 
 
+MAX_BUCKETS = 65536   # ref: search.max_buckets default
+
+
 class HistogramAgg(BucketAgg):
     type_name = "histogram"
 
     def _interval(self):
-        return float(self.params["interval"])
+        interval = float(self.params["interval"])
+        if interval <= 0:
+            raise IllegalArgumentError("[interval] must be a positive decimal number")
+        return interval
 
     def _key_of(self, vals: np.ndarray) -> np.ndarray:
         interval = self._interval()
@@ -727,8 +776,11 @@ class HistogramAgg(BucketAgg):
         buckets = []
         if keys and min_count == 0:
             # fill empty buckets between min and max (ref: histogram
-            # empty-bucket filling)
+            # empty-bucket filling), capped like search.max_buckets
             interval = self._interval()
+            if (keys[-1] - keys[0]) / interval > MAX_BUCKETS:
+                raise IllegalArgumentError(
+                    f"trying to create too many buckets (> {MAX_BUCKETS})")
             full = []
             k = keys[0]
             while k <= keys[-1] + 1e-9:
@@ -740,6 +792,9 @@ class HistogramAgg(BucketAgg):
             interval = self._interval()
             lo = self._key_of(np.asarray([float(ext["min"])]))[0]
             hi = self._key_of(np.asarray([float(ext["max"])]))[0]
+            if (hi - lo) / interval > MAX_BUCKETS:
+                raise IllegalArgumentError(
+                    f"trying to create too many buckets (> {MAX_BUCKETS})")
             existing = set(keys)
             k = lo
             while k <= hi + 1e-9:
@@ -992,7 +1047,7 @@ class GlobalAgg(BucketAgg):
     finalize = FilterAgg.finalize
 
 
-class CompositeAgg(Agg):
+class CompositeAgg(BucketAgg):
     """Paginated multi-source buckets (ref: bucket/composite/)."""
 
     type_name = "composite"
@@ -1031,23 +1086,22 @@ class CompositeAgg(Agg):
                     else:
                         vals = [raw[o] if col.exists[o] else None for o in sel]
             key_parts.append(vals)
+        doc_lists: Dict[tuple, List[int]] = {}
         for i in range(len(sel)):
             key = tuple(part[i] for part in key_parts)
             if any(v is None for v in key):
                 continue
-            buckets[key] = buckets.get(key, 0) + 1
-        # sub-agg collection per composite bucket is deferred (rare); counts only
-        return {repr(k): {"key": list(k), "doc_count": c} for k, c in buckets.items()}
+            doc_lists.setdefault(key, []).append(int(sel[i]))
+        out = {}
+        for k, doc_list in doc_lists.items():
+            doc_mask = np.zeros(ctx.leaf.n_docs, bool)
+            doc_mask[doc_list] = True
+            out[repr(k)] = {"key": list(k), **self._bucket(ctx, doc_mask)}
+        return out
 
     def reduce(self, partials):
-        merged: Dict[str, dict] = {}
-        for p in partials:
-            for rk, b in p.items():
-                m = merged.get(rk)
-                if m is None:
-                    merged[rk] = dict(b)
-                else:
-                    m["doc_count"] += b["doc_count"]
+        merged = self._merge_buckets(partials)
+        # _merge_buckets keys by repr(key); restore the key payload
         return merged
 
     def finalize(self, partial):
@@ -1060,8 +1114,11 @@ class CompositeAgg(Agg):
             after_key = [after.get(n) for n in names]
             items = [b for b in items if b["key"] > after_key]
         page = items[:size]
-        buckets = [{"key": dict(zip(names, b["key"])), "doc_count": b["doc_count"]}
-                   for b in page]
+        buckets = []
+        for b in page:
+            bucket = {"key": dict(zip(names, b["key"])), "doc_count": b["doc_count"]}
+            bucket.update(self._finalize_sub(b["sub"]))
+            buckets.append(bucket)
         out = {"buckets": buckets}
         if page:
             out["after_key"] = dict(zip(names, page[-1]["key"]))

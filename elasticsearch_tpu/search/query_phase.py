@@ -145,7 +145,7 @@ def execute_query_phase(
             mask = mask & (scores >= float(min_score))
         total += int(jnp.sum(mask.astype(jnp.int32)))
         if aggs_spec:
-            leaf_masks.append((leaf, np.asarray(mask)))
+            leaf_masks.append((leaf, np.asarray(mask), np.asarray(scores)))
 
         if sort:
             collected.extend(_collect_sorted(leaf, leaf_idx, scores, mask, sort, k))
@@ -179,7 +179,7 @@ def execute_query_phase(
         threshold = int(track)
         if total > threshold:
             relation = "gte"
-            total = max(total, threshold)
+            total = min(total, threshold)
     elif track is False:
         relation = "gte"
 
@@ -192,8 +192,9 @@ def execute_query_phase(
         aggs, _ = parse_aggs(aggs_spec)
         partials = [
             collect_leaf(aggs, AggContext(leaf=leaf, mapper=mapper, executor=ex,
-                                          live=np.asarray(leaf.live_dev())), m)
-            for leaf, m in leaf_masks
+                                          live=np.asarray(leaf.live_dev()),
+                                          scores=sc), m)
+            for leaf, m, sc in leaf_masks
         ]
         # reduce leaves within the shard; the coordinator reduces shards and
         # finalizes (ref P6: partials stay commutative until the final reduce)

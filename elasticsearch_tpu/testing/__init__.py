@@ -1,0 +1,4 @@
+from elasticsearch_tpu.testing.deterministic import DeterministicTaskQueue
+from elasticsearch_tpu.testing.linearizability import LinearizabilityChecker
+
+__all__ = ["DeterministicTaskQueue", "LinearizabilityChecker"]

@@ -312,3 +312,51 @@ def test_bucket_selector_with_params(engine):
                               "script": {"source": "r > params['lim']",
                                          "params": {"lim": 40}}}}}}}})
     assert sorted(b["key"] for b in r["aggregations"]["cats"]["buckets"]) == ["b", "c"]
+
+
+def test_histogram_rejects_bad_interval(engine):
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    for interval in (0, -1):
+        with pytest.raises(IllegalArgumentError):
+            search(engine, {"size": 0, "aggs": {
+                "h": {"histogram": {"field": "price", "interval": interval}}}})
+
+
+def test_histogram_bucket_explosion_capped():
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+    e = InternalEngine(MapperService(dict(MAPPING)))
+    e.index("1", {"price": 0.0})
+    e.index("2", {"price": 1e9})
+    e.refresh()
+    with pytest.raises(IllegalArgumentError):
+        search(e, {"size": 0, "aggs": {
+            "h": {"histogram": {"field": "price", "interval": 0.001}}}})
+
+
+def test_track_total_hits_clamps(engine):
+    r = search(engine, {"size": 0, "track_total_hits": 2,
+                        "query": {"range": {"price": {"gte": 0}}}})
+    assert r["hits"]["total"] == {"value": 2, "relation": "gte"}
+
+
+def test_composite_with_sub_aggs(engine):
+    r = search(engine, {"size": 0, "aggs": {
+        "comp": {"composite": {"sources": [{"cat": {"terms": {"field": "category"}}}]},
+                 "aggs": {"rev": {"sum": {"field": "price"}}}}}})
+    buckets = r["aggregations"]["comp"]["buckets"]
+    by_cat = {b["key"]["cat"]: b for b in buckets}
+    assert by_cat["a"]["rev"]["value"] == 30.0
+    assert by_cat["b"]["rev"]["value"] == 70.0
+
+
+def test_top_hits_respects_scores_and_sort(engine):
+    # query scores rank 'alpha' docs; top hit must be the best-scoring one
+    r = search(engine, {"size": 0, "query": {"match": {"body": "alpha"}}, "aggs": {
+        "top": {"top_hits": {"size": 2}},
+        "cheapest": {"top_hits": {"size": 1, "sort": [{"price": {"order": "asc"}}]}},
+    }})
+    top = r["aggregations"]["top"]["hits"]["hits"]
+    assert len(top) == 2
+    assert top[0]["_score"] >= top[1]["_score"] > 0
+    cheapest = r["aggregations"]["cheapest"]["hits"]["hits"]
+    assert cheapest[0]["_source"]["price"] == 10.0

@@ -1,0 +1,379 @@
+"""Deterministic distributed simulation of the coordination layer.
+
+The tier-3 test strategy from the reference (ref:
+AbstractCoordinatorTestCase.java): full Coordinator instances over a
+DisruptableTransport on a DeterministicTaskQueue — virtual time, seeded
+interleavings — with safety checked by invariants and a linearizability
+checker over the replicated register.
+"""
+
+import random
+
+import pytest
+
+from elasticsearch_tpu.cluster.coordination import (
+    Coordinator, PublishedState,
+)
+from elasticsearch_tpu.testing.deterministic import DeterministicTaskQueue
+from elasticsearch_tpu.testing.disruptable_transport import DisruptableTransport
+from elasticsearch_tpu.testing.linearizability import (
+    CasRegisterSpec, Event, LinearizabilityChecker,
+)
+
+
+class SimCluster:
+    def __init__(self, node_ids, seed=0):
+        self.queue = DeterministicTaskQueue(seed)
+        self.transport = DisruptableTransport(self.queue)
+        config = frozenset(node_ids)
+        initial = PublishedState(term=0, version=0, value=None,
+                                 config=config, last_committed_config=config)
+        self.nodes = {}
+        self.committed = {n: [] for n in node_ids}
+        for n in node_ids:
+            rng = random.Random(hash((seed, n)) & 0xFFFF)
+            node = Coordinator(
+                n, initial, self.transport, self.queue, rng,
+                on_commit=lambda st, n=n: self.committed[n].append(st))
+            self.nodes[n] = node
+            self.transport.register(n, node.handle_message)
+
+    def start(self):
+        for n in self.nodes.values():
+            n.start()
+
+    def run(self, ms):
+        self.queue.run_until(self.queue.now_ms + ms)
+
+    def leaders(self):
+        return [n for n in self.nodes.values() if n.mode == "LEADER"]
+
+    def stable_leader(self):
+        ls = self.leaders()
+        assert len(ls) == 1, f"expected one leader, got {[l.node_id for l in ls]}"
+        return ls[0]
+
+
+def test_single_node_elects_itself():
+    c = SimCluster(["n0"])
+    c.start()
+    c.run(5_000)
+    leader = c.stable_leader()
+    assert leader.node_id == "n0"
+    assert c.committed["n0"]   # the no-op republish committed
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_three_nodes_elect_exactly_one_leader(seed):
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(30_000)
+    leader = c.stable_leader()
+    # everyone else follows that leader
+    for n in c.nodes.values():
+        if n is not leader:
+            assert n.mode == "FOLLOWER"
+            assert n.leader_id == leader.node_id
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_publish_reaches_all_nodes(seed):
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(30_000)
+    leader = c.stable_leader()
+    leader.publish({"doc": 42})
+    c.run(5_000)
+    for n, states in c.committed.items():
+        assert states, f"{n} committed nothing"
+        assert states[-1].value == {"doc": 42}
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_leader_loss_triggers_reelection(seed):
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(30_000)
+    old = c.stable_leader()
+    c.transport.isolate(old.node_id)
+    c.run(60_000)
+    remaining = [n for n in c.nodes.values()
+                 if n.node_id != old.node_id and n.mode == "LEADER"]
+    assert len(remaining) == 1
+    new_leader = remaining[0]
+    assert new_leader.state.current_term > old.state.current_term
+    # the isolated old leader cannot commit anything new
+    before = len(c.committed[old.node_id])
+    try:
+        old.publish({"stale": True})
+    except Exception:
+        pass
+    c.run(10_000)
+    stale_commits = c.committed[old.node_id][before:]
+    assert all(s.value != {"stale": True} for s in stale_commits)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_minority_partition_cannot_commit(seed):
+    c = SimCluster(["n0", "n1", "n2", "n4", "n5"], seed=seed)
+    c.start()
+    c.run(30_000)
+    leader = c.stable_leader()
+    others = [n for n in c.nodes if n != leader.node_id]
+    minority = {leader.node_id, others[0]}
+    majority = set(others[1:])
+    c.transport.partition(minority, majority)
+    # leader in minority: publishes must not commit anywhere
+    n_before = {n: len(c.committed[n]) for n in c.nodes}
+    try:
+        leader.publish({"lost": True})
+    except Exception:
+        pass
+    c.run(60_000)
+    for n in majority:
+        vals = [s.value for s in c.committed[n][n_before[n]:]]
+        assert {"lost": True} not in vals
+    # majority side elects a fresh leader and can commit
+    maj_leaders = [c.nodes[n] for n in majority if c.nodes[n].mode == "LEADER"]
+    assert len(maj_leaders) == 1
+    maj_leaders[0].publish({"fresh": True})
+    c.run(10_000)
+    for n in majority:
+        assert c.committed[n][-1].value == {"fresh": True}
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_partition_heals_and_converges(seed):
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(30_000)
+    leader = c.stable_leader()
+    c.transport.isolate(leader.node_id)
+    c.run(60_000)
+    c.transport.heal()
+    c.run(60_000)
+    ls = c.leaders()
+    assert len(ls) == 1
+    ls[0].publish({"converged": True})
+    c.run(10_000)
+    versions = {c.committed[n][-1].version for n in c.nodes if c.committed[n]}
+    values = [c.committed[n][-1].value for n in c.nodes if c.committed[n]]
+    assert all(v == {"converged": True} for v in values)
+    assert len(versions) == 1
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_committed_states_form_single_history(seed):
+    """Safety invariant: across all nodes, committed (term, version) -> value
+    is a function, and versions on each node are monotonic."""
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(20_000)
+    # publish from whoever leads, with disruptions between rounds
+    for round_ in range(3):
+        ls = c.leaders()
+        if len(ls) == 1:
+            try:
+                ls[0].publish({"round": round_, "seed": seed})
+            except Exception:
+                pass
+        if round_ == 1:
+            victim = list(c.nodes)[seed % 3]
+            c.transport.isolate(victim)
+            c.run(20_000)
+            c.transport.heal()
+        c.run(20_000)
+    seen = {}
+    for n, states in c.committed.items():
+        versions = [s.version for s in states]
+        assert versions == sorted(versions), f"{n} saw non-monotonic versions"
+        for s in states:
+            key = (s.term, s.version)
+            if key in seen:
+                assert seen[key] == s.value, (
+                    f"divergent committed value at {key}")
+            else:
+                seen[key] = s.value
+
+
+def test_linearizability_checker_accepts_valid_history():
+    checker = LinearizabilityChecker(CasRegisterSpec())
+    # w0: cas(0->A) ok; concurrent w1: cas(0->B) fails; read sees (1, A)
+    history = [
+        Event("invoke", 0, ("write", (0, "A"))),
+        Event("invoke", 1, ("write", (0, "B"))),
+        Event("response", 0, True),
+        Event("response", 1, False),
+        Event("invoke", 2, ("read", None)),
+        Event("response", 2, (1, "A")),
+    ]
+    assert checker.is_linearizable(history)
+
+
+def test_linearizability_checker_rejects_divergence():
+    checker = LinearizabilityChecker(CasRegisterSpec())
+    # both CAS(0->X) claims succeeded: impossible for one register
+    history = [
+        Event("invoke", 0, ("write", (0, "A"))),
+        Event("invoke", 1, ("write", (0, "B"))),
+        Event("response", 0, True),
+        Event("response", 1, True),
+    ]
+    assert not checker.is_linearizable(history)
+
+
+def test_linearizability_checker_rejects_stale_read_after_ack():
+    checker = LinearizabilityChecker(CasRegisterSpec())
+    # write committed and acknowledged BEFORE the read was invoked, but the
+    # read still saw the initial state: a real-time violation
+    history = [
+        Event("invoke", 0, ("write", (0, "A"))),
+        Event("response", 0, True),
+        Event("invoke", 1, ("read", None)),
+        Event("response", 1, (0, None)),
+    ]
+    assert not checker.is_linearizable(history)
+
+
+def test_linearizability_checker_incomplete_ops_optional():
+    checker = LinearizabilityChecker(CasRegisterSpec())
+    # a write with no response may or may not have happened: both read
+    # outcomes are linearizable
+    for observed in [(0, None), (1, "A")]:
+        history = [
+            Event("invoke", 0, ("write", (0, "A"))),
+            Event("invoke", 1, ("read", None)),
+            Event("response", 1, observed),
+        ]
+        assert checker.is_linearizable(history), observed
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_acknowledged_writes_survive_in_order(seed):
+    """State-machine-replication witness: every write acknowledged by commit
+    appears in every node's committed history, and in the same relative
+    order everywhere — across leader churn and partitions."""
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(30_000)
+    acked = []
+    for i in range(4):
+        ls = c.leaders()
+        if len(ls) == 1:
+            value = {"w": i, "seed": seed}
+            try:
+                ls[0].publish(value)
+            except Exception:
+                value = None
+            c.run(15_000)
+            if value is not None and any(
+                    s.value == value for s in c.committed[ls[0].node_id]):
+                acked.append(value)
+        if i == 1:
+            victim = list(c.nodes)[(seed + 1) % 3]
+            c.transport.isolate(victim)
+            c.run(40_000)
+            c.transport.heal()
+        c.run(20_000)
+    c.run(60_000)
+    assert acked, "no write was ever acknowledged"
+    for n, states in c.committed.items():
+        vals = [s.value for s in states]
+        positions = [vals.index(a) for a in acked if a in vals]
+        # all acked writes present on every healed node...
+        missing = [a for a in acked if a not in vals]
+        assert not missing, f"{n} lost acknowledged writes {missing}"
+        # ...and in the same order they were acknowledged
+        assert positions == sorted(positions), f"{n} reordered writes"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_isolated_node_catches_up_after_heal(seed):
+    """A write committed by the majority DURING the partition must reach the
+    isolated node after healing (lag detection + catch-up publish)."""
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(30_000)
+    leader = c.stable_leader()
+    victim = next(n for n in c.nodes.values() if n is not leader)
+    c.transport.isolate(victim.node_id)
+    c.run(20_000)
+    value = {"while_partitioned": True, "seed": seed}
+    leader.publish(value)
+    c.run(15_000)
+    # majority committed it; victim did not
+    assert any(s.value == value for s in c.committed[leader.node_id])
+    assert not any(s.value == value for s in c.committed[victim.node_id])
+    c.transport.heal()
+    c.run(60_000)
+    assert any(s.value == value for s in c.committed[victim.node_id]), \
+        "victim never caught up"
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_isolated_leader_cannot_shrink_config_to_itself(seed):
+    """Regression: an isolated leader's failed-follower reconfigurations must
+    never commit (joint consensus anchors on the last COMMITTED config), and
+    the leader must step down after the publication timeout."""
+    c = SimCluster(["n0", "n1", "n2", "n3", "n4"], seed=seed)
+    c.start()
+    c.run(30_000)
+    leader = c.stable_leader()
+    c.transport.isolate(leader.node_id)
+    c.run(300_000)   # long isolation: follower checks fail, shrinks attempted
+    # nothing committed on the isolated node beyond what it had
+    for s in c.committed[leader.node_id]:
+        assert len(s.config) >= 3, f"committed dangerously small config {s.config}"
+    # publication timeout forced it out of LEADER mode
+    assert leader.mode != "LEADER"
+    # majority side is healthy with a proper config
+    maj = [n for n in c.nodes.values()
+           if n.node_id != leader.node_id and n.mode == "LEADER"]
+    assert len(maj) == 1
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_sequential_leader_failures_with_autoshrink(seed):
+    """5 nodes, kill 3 successive leaders: auto-reconfiguration keeps the
+    shrinking remainder quorate (static config would die at the 3rd kill)."""
+    c = SimCluster(["n0", "n1", "n2", "n3", "n4"], seed=seed)
+    c.start()
+    c.run(30_000)
+    isolated = set()
+    for round_ in range(3):
+        ls = [n for n in c.nodes.values()
+              if n.mode == "LEADER" and n.node_id not in isolated]
+        assert len(ls) == 1, f"round {round_}"
+        ls[0].publish({"round": round_})
+        c.run(10_000)
+        c.transport.isolate(ls[0].node_id)
+        isolated.add(ls[0].node_id)
+        c.run(90_000)
+    alive_leaders = [n for n in c.nodes.values()
+                     if n.node_id not in isolated and n.mode == "LEADER"]
+    assert len(alive_leaders) == 1
+    alive_leaders[0].publish({"survived": True})
+    c.run(10_000)
+    for n in c.nodes.values():
+        if n.node_id not in isolated:
+            assert c.committed[n.node_id][-1].value == {"survived": True}
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_removed_node_rejoins_after_heal(seed):
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(30_000)
+    leader = c.stable_leader()
+    victim = next(n for n in c.nodes.values() if n is not leader)
+    c.transport.isolate(victim.node_id)
+    c.run(120_000)   # leader shrinks config, removing the victim
+    ls = [n for n in c.nodes.values() if n.mode == "LEADER"]
+    assert len(ls) == 1
+    assert victim.node_id not in ls[0].state.accepted.config
+    c.transport.heal()
+    c.run(120_000)   # victim discovers the leader and asks to rejoin
+    ls = [n for n in c.nodes.values() if n.mode == "LEADER"]
+    assert len(ls) == 1
+    assert victim.node_id in ls[0].state.accepted.config
+    assert victim.mode == "FOLLOWER"

@@ -48,3 +48,20 @@ def test_normalize_preserves_strings_and_identifiers():
     assert compile_script("doc['annulled'].value").execute(env) == 3.0
     assert compile_script("doc['status'].value == 'null'").execute(env) is True
     assert compile_script("nullable + 1").execute({"nullable": 1}) == 2
+
+
+def test_compute_limits():
+    with pytest.raises(ScriptException):
+        compile_script("9**9**7").execute()
+    with pytest.raises(ScriptException):
+        compile_script("s * 1000000000").execute({"s": "a"})
+    assert compile_script("2**10").execute() == 1024
+
+
+def test_params_attribute_access():
+    assert compile_script("v * params.f").execute(
+        {"v": 3, "params": {"f": 2}}) == 6
+    assert compile_script("v * params['f']").execute(
+        {"v": 3, "params": {"f": 2}}) == 6
+    with pytest.raises(ScriptException):
+        compile_script("params.missing").execute({"params": {}})
