@@ -124,11 +124,19 @@ class InternalEngine:
         if_primary_term: Optional[int] = None,
         op_type: str = "index",
         from_translog: bool = False,
+        op_primary_term: Optional[int] = None,
     ) -> EngineResult:
         """Index or update one document (ref: InternalEngine.index:842)."""
         with self._lock:
+            self._check_op_term(op_primary_term)
             entry = self._versions.get(doc_id)
             exists = entry is not None and not entry.deleted
+            if seq_no is not None and entry is not None and entry.seq_no >= seq_no:
+                # replica/replay path: op is older than what we already hold
+                # (ref: InternalEngine OpVsLuceneDocStatus.OP_STALE_OR_EQUAL)
+                self._seqno.mark_processed(seq_no)
+                return EngineResult(doc_id, entry.version, seq_no,
+                                    self.primary_term, "noop")
             if if_seq_no is not None or if_primary_term is not None:
                 cur_seq = entry.seq_no if entry else NO_OPS_PERFORMED
                 if not exists or cur_seq != if_seq_no or self.primary_term != if_primary_term:
@@ -168,10 +176,16 @@ class InternalEngine:
         if_seq_no: Optional[int] = None,
         if_primary_term: Optional[int] = None,
         from_translog: bool = False,
+        op_primary_term: Optional[int] = None,
     ) -> EngineResult:
         with self._lock:
+            self._check_op_term(op_primary_term)
             entry = self._versions.get(doc_id)
             exists = entry is not None and not entry.deleted
+            if seq_no is not None and entry is not None and entry.seq_no >= seq_no:
+                self._seqno.mark_processed(seq_no)
+                return EngineResult(doc_id, entry.version, seq_no,
+                                    self.primary_term, "noop")
             if if_seq_no is not None or if_primary_term is not None:
                 cur_seq = entry.seq_no if entry else NO_OPS_PERFORMED
                 if not exists or cur_seq != if_seq_no or self.primary_term != if_primary_term:
@@ -180,6 +194,12 @@ class InternalEngine:
                     )
             seq = seq_no if seq_no is not None else self._seqno.generate_seq_no()
             if not exists:
+                if seq_no is not None:
+                    # replica path: record the tombstone so a stale index op
+                    # arriving later cannot resurrect the doc
+                    self._versions[doc_id] = _VersionEntry(
+                        seq_no=seq, version=(entry.version + 1) if entry else 1,
+                        deleted=True)
                 self._seqno.mark_processed(seq)
                 return EngineResult(doc_id, entry.version if entry else 1, seq,
                                     self.primary_term, "not_found")
@@ -196,6 +216,18 @@ class InternalEngine:
                                    "primary_term": self.primary_term, "version": version})
             self._seqno.mark_processed(seq)
             return EngineResult(doc_id, version, seq, self.primary_term, "deleted")
+
+    def _check_op_term(self, op_primary_term: Optional[int]) -> None:
+        """Primary-term fencing on the replica path (ref: IndexShard
+        acquireReplicaOperationPermit — ops from a deposed primary are
+        rejected; a newer term is adopted)."""
+        if op_primary_term is None:
+            return
+        if op_primary_term < self.primary_term:
+            raise VersionConflictError(
+                f"operation primary term [{op_primary_term}] is too old "
+                f"(current [{self.primary_term}])")
+        self.primary_term = op_primary_term
 
     def _tombstone(self, seg_idx: int, ord_: int) -> None:
         self._live[seg_idx][ord_] = False
@@ -217,6 +249,30 @@ class InternalEngine:
             seg = self._segments[entry.seg_idx]
             return {"_id": doc_id, "_version": entry.version, "_seq_no": entry.seq_no,
                     "_primary_term": self.primary_term, "_source": seg.sources[entry.ord]}
+
+    def changes_since(self, min_seq_no: int) -> List[dict]:
+        """Operation history above a seqno, latest op per doc, seqno-ordered
+        (ref: index/engine/LuceneChangesSnapshot.java — ops-based peer
+        recovery and CCR read from the index's retained history; here the
+        version map + segments retain the latest op for every doc including
+        tombstones)."""
+        with self._lock:
+            ops = []
+            for doc_id, entry in self._versions.items():
+                if entry.seq_no <= min_seq_no:
+                    continue
+                if entry.deleted:
+                    ops.append({"op": "delete", "id": doc_id, "seq_no": entry.seq_no,
+                                "version": entry.version})
+                else:
+                    if entry.in_buffer:
+                        source = self._buffer[doc_id][0].source
+                    else:
+                        source = self._segments[entry.seg_idx].sources[entry.ord]
+                    ops.append({"op": "index", "id": doc_id, "seq_no": entry.seq_no,
+                                "version": entry.version, "source": source})
+            ops.sort(key=lambda o: o["seq_no"])
+            return ops
 
     def acquire_searcher(self) -> EngineSearcher:
         with self._lock:

@@ -1,0 +1,195 @@
+"""Shard replication: primary/replica groups with seqno-acked writes.
+
+Re-designs the reference's replication template (ref:
+action/support/replication/ReplicationOperation.java:99 — primary executes,
+fans to every in-sync replica, collects acks, fails stale copies via the
+master, advances the global checkpoint; index/seqno/ReplicationTracker.java
+for the checkpoint algebra; indices/recovery/RecoverySourceHandler.java:139
+for peer recovery) around the TPU engine:
+
+  * writes execute on the primary engine, then replicate the seqno-stamped
+    op to every in-sync copy through a pluggable channel (direct call in
+    one process, transport action across nodes);
+  * a failed replica is reported to the failure listener (the master's
+    shard-failed path) and dropped from the in-sync set;
+  * peer recovery = phase1 segment snapshot copy + phase2 ops replay above
+    the snapshot's max seqno, then mark in-sync — writes concurrent with
+    recovery flow to the new copy as soon as it is tracked, and the engine's
+    per-doc seqno comparison makes replayed ops idempotent;
+  * failover promotes a replica: bumps the primary term and resyncs copies
+    above the global checkpoint (ref: index/shard/PrimaryReplicaSyncer.java).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.index.engine import EngineResult, InternalEngine
+from elasticsearch_tpu.index.seqno import NO_OPS_PERFORMED, ReplicationTracker
+
+
+class ReplicationFailedError(ElasticsearchTpuError):
+    status = 503
+    error_type = "replication_failed_exception"
+
+
+@dataclass
+class ShardCopy:
+    """One physical copy of the shard."""
+
+    allocation_id: str
+    node_id: str
+    engine: InternalEngine
+
+
+class ReplicationGroup:
+    """Primary-side controller for one shard's copies."""
+
+    def __init__(self, primary: ShardCopy,
+                 on_replica_failure: Optional[Callable[[str, Exception], None]] = None):
+        self._lock = threading.RLock()
+        self.primary = primary
+        self.tracker = ReplicationTracker(primary.allocation_id)
+        self.tracker.mark_in_sync(primary.allocation_id)
+        self.replicas: Dict[str, ShardCopy] = {}
+        self.on_replica_failure = on_replica_failure or (lambda aid, e: None)
+
+    # ---- write path (ref: ReplicationOperation.execute) ----
+
+    def index(self, doc_id: str, source: dict, **kw) -> EngineResult:
+        with self._lock:
+            result = self.primary.engine.index(doc_id, source, **kw)
+            self._replicate({"op": "index", "id": doc_id, "source": source,
+                             "seq_no": result.seq_no,
+                             "primary_term": result.primary_term})
+            self._after_write()
+            return result
+
+    def delete(self, doc_id: str, **kw) -> EngineResult:
+        with self._lock:
+            result = self.primary.engine.delete(doc_id, **kw)
+            self._replicate({"op": "delete", "id": doc_id,
+                             "seq_no": result.seq_no,
+                             "primary_term": result.primary_term})
+            self._after_write()
+            return result
+
+    def _replicate(self, op: dict) -> None:
+        in_sync = self.tracker.in_sync_ids
+        tracked = {aid: c for aid, c in self.replicas.items()}
+        for aid, copy in tracked.items():
+            required = aid in in_sync
+            try:
+                self._apply_to_copy(copy, op)
+                self.tracker.update_local_checkpoint(
+                    aid, copy.engine.local_checkpoint)
+            except Exception as e:  # noqa: BLE001 — any failure fails the copy
+                self._fail_replica(aid, e)
+                if required:
+                    # in the reference the master confirms the failure before
+                    # the write acks; here the listener is invoked inline
+                    pass
+
+    @staticmethod
+    def _apply_to_copy(copy: ShardCopy, op: dict) -> None:
+        term = op.get("primary_term")
+        if op["op"] == "index":
+            copy.engine.index(op["id"], op["source"], seq_no=op["seq_no"],
+                              op_primary_term=term)
+        else:
+            copy.engine.delete(op["id"], seq_no=op["seq_no"],
+                               op_primary_term=term)
+
+    def _after_write(self) -> None:
+        self.tracker.update_local_checkpoint(
+            self.primary.allocation_id, self.primary.engine.local_checkpoint)
+
+    def _fail_replica(self, allocation_id: str, error: Exception) -> None:
+        self.replicas.pop(allocation_id, None)
+        self.tracker.remove_tracking(allocation_id)
+        self.on_replica_failure(allocation_id, error)
+
+    # ---- peer recovery (ref: RecoverySourceHandler.recoverToTarget) ----
+
+    def add_replica(self, copy: ShardCopy) -> None:
+        """Recover a new copy and bring it in-sync.
+
+        phase0: track the copy so concurrent writes reach it immediately;
+        phase1: snapshot the primary's published segments and install them;
+        phase2: replay ops above the snapshot's max seqno (the engine's
+        stale-op checks make overlap with live writes idempotent);
+        finalize: mark in-sync.
+        """
+        with self._lock:
+            self.replicas[copy.allocation_id] = copy
+            self.tracker.add_tracking(copy.allocation_id)
+
+        # phase1: segment-file copy, modeled as a deep snapshot transfer
+        term = self.primary.engine.primary_term
+        snapshot_ops = self.primary.engine.changes_since(NO_OPS_PERFORMED)
+        for op in snapshot_ops:
+            self._apply_to_copy(copy, {"op": op["op"], "id": op["id"],
+                                       "source": op.get("source"),
+                                       "seq_no": op["seq_no"],
+                                       "primary_term": term})
+        # phase2: replay anything that arrived while phase1 streamed
+        with self._lock:
+            gap_ops = self.primary.engine.changes_since(copy.engine.local_checkpoint)
+            for op in gap_ops:
+                self._apply_to_copy(copy, {"op": op["op"], "id": op["id"],
+                                           "source": op.get("source"),
+                                           "seq_no": op["seq_no"],
+                                           "primary_term": term})
+            copy.engine.refresh()
+            self.tracker.update_local_checkpoint(
+                copy.allocation_id, copy.engine.local_checkpoint)
+            self.tracker.mark_in_sync(copy.allocation_id)
+
+    # ---- failover (ref: IndexShard primary promotion + PrimaryReplicaSyncer) ----
+
+    def promote(self, allocation_id: str) -> "ReplicationGroup":
+        """Promote a replica to primary after primary loss. Returns the new
+        group; remaining replicas resync from the new primary."""
+        with self._lock:
+            new_primary = self.replicas.pop(allocation_id)
+            new_primary.engine.primary_term = self.primary.engine.primary_term + 1
+            group = ReplicationGroup(new_primary, self.on_replica_failure)
+            survivors = dict(self.replicas)
+        for aid, copy in survivors.items():
+            # primary/replica resync: replay the new primary's ops above the
+            # copy's local checkpoint so all copies converge on ITS history
+            ops = new_primary.engine.changes_since(copy.engine.local_checkpoint)
+            try:
+                for op in ops:
+                    self._apply_to_copy(copy, {"op": op["op"], "id": op["id"],
+                                               "source": op.get("source"),
+                                               "seq_no": op["seq_no"],
+                                               "primary_term": new_primary.engine.primary_term})
+            except Exception as e:  # noqa: BLE001
+                group.on_replica_failure(aid, e)
+                continue
+            group.replicas[aid] = copy
+            group.tracker.add_tracking(aid)
+            group.tracker.update_local_checkpoint(aid, copy.engine.local_checkpoint)
+            group.tracker.mark_in_sync(aid)
+        group._after_write()
+        return group
+
+    # ---- introspection ----
+
+    @property
+    def global_checkpoint(self) -> int:
+        return self.tracker.global_checkpoint
+
+    def copies(self) -> List[ShardCopy]:
+        with self._lock:
+            return [self.primary, *self.replicas.values()]
+
+
+def new_allocation_id() -> str:
+    return uuid.uuid4().hex[:20]
