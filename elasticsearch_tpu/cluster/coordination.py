@@ -216,7 +216,6 @@ class Coordinator:
         self._election_handle = None
         self._checker_handle = None
         self._follower_failures: Dict[str, int] = {}
-        self._publish_in_flight: Optional[dict] = None
         self.stopped = False
 
     # ---- lifecycle ----
@@ -386,6 +385,16 @@ class Coordinator:
             try:
                 resp = self.state.handle_publish_request(st)
             except CoordinationError:
+                # idempotent re-ack when the leader re-sends the state we
+                # already accepted (catch-up of a follower that accepted a
+                # version but missed its commit — without this the commit is
+                # never re-sent and the follower lags forever)
+                if (st.term == self.state.current_term
+                        and st.term == self.state.accepted.term
+                        and st.version == self.state.accepted.version):
+                    self._become_follower(sender)
+                    reply({"type": "publish_response", "node_id": self.node_id,
+                           "term": st.term, "version": st.version})
                 return
             self._become_follower(sender)
             reply({"type": "publish_response", "node_id": resp.node_id,
@@ -404,7 +413,15 @@ class Coordinator:
                        "last_committed_version": self.state.last_committed_version,
                        "last_committed_term": self.state.accepted.term})
             elif msg["term"] >= self.state.current_term:
-                # not yet following this leader: accept it
+                # not yet following this leader: adopt its term first, else
+                # our stale-term leader_checks would bounce us straight back
+                # to candidate (ref: Coordinator.onFollowerCheckRequest calls
+                # ensureTermAtLeast before becomeFollower)
+                if msg["term"] > self.state.current_term:
+                    try:
+                        self.state.handle_start_join(sender, msg["term"])
+                    except CoordinationError:
+                        pass
                 self._become_follower(sender)
                 reply({"type": "follower_check_response", "ok": True,
                        "last_committed_version": self.state.last_committed_version,

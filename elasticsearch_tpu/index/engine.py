@@ -229,6 +229,113 @@ class InternalEngine:
                 f"(current [{self.primary_term}])")
         self.primary_term = op_primary_term
 
+    def advance_primary_term(self, term: int) -> None:
+        """Adopt a newer primary term (replica-side fencing bump on failover;
+        ref: IndexShard.acquireReplicaOperationPermit term adoption). Happens
+        explicitly during resync so fully-caught-up survivors — which replay
+        zero ops — still reject the deposed primary's writes."""
+        with self._lock:
+            if term > self.primary_term:
+                self.primary_term = term
+
+    def docs_above(self, seq_no: int) -> List[str]:
+        """Doc ids whose latest op is above seq_no (divergence candidates)."""
+        with self._lock:
+            return [d for d, e in self._versions.items() if e.seq_no > seq_no]
+
+    def doc_resync_state(self, doc_id: str) -> Optional[dict]:
+        """Authoritative latest state of one doc for primary-replica resync."""
+        with self._lock:
+            entry = self._versions.get(doc_id)
+            if entry is None:
+                return None
+            if entry.deleted:
+                return {"deleted": True, "seq_no": entry.seq_no, "version": entry.version}
+            if entry.in_buffer:
+                source = self._buffer[doc_id][0].source
+            else:
+                source = self._segments[entry.seg_idx].sources[entry.ord]
+            return {"deleted": False, "seq_no": entry.seq_no,
+                    "version": entry.version, "source": source}
+
+    def force_resync_doc(self, doc_id: str, state: Optional[dict]) -> None:
+        """Replace this copy's state for one doc with the new primary's
+        authoritative state, discarding divergent local history — the per-doc
+        form of the reference's engine rollback to the global checkpoint
+        during primary-replica resync (ref: index/shard/IndexShard.java
+        resetEngineToGlobalCheckpoint)."""
+        with self._lock:
+            entry = self._versions.get(doc_id)
+            if entry is not None and state is not None \
+                    and entry.seq_no == state["seq_no"] \
+                    and entry.version == state["version"] \
+                    and entry.deleted == state["deleted"]:
+                return  # already identical — don't churn segments/caches
+            if entry is not None and not entry.deleted:
+                if entry.in_buffer:
+                    self._buffer.pop(doc_id, None)
+                    if doc_id in self._buffer_order:
+                        self._buffer_order.remove(doc_id)
+                elif entry.seg_idx >= 0:
+                    self._tombstone(entry.seg_idx, entry.ord)
+            if state is None:
+                self._versions.pop(doc_id, None)
+            elif state["deleted"]:
+                self._versions[doc_id] = _VersionEntry(
+                    seq_no=state["seq_no"], version=state["version"], deleted=True)
+            else:
+                doc = self.mapper.parse(doc_id, state["source"])
+                self._buffer[doc_id] = (doc, state["seq_no"], state["version"])
+                self._buffer_order.append(doc_id)
+                self._versions[doc_id] = _VersionEntry(
+                    seq_no=state["seq_no"], version=state["version"],
+                    deleted=False, in_buffer=True)
+
+    def reset_local_checkpoint(self, seq_no: int) -> None:
+        """Rebuild the seqno tracker at a rollback point, discarding marks
+        from a divergent history (resync resets to the global checkpoint).
+        The translog is trimmed at the same point so crash recovery cannot
+        resurrect the divergent tail."""
+        with self._lock:
+            self._seqno = LocalCheckpointTracker(max_seq_no=seq_no, local_checkpoint=seq_no)
+            if self.translog is not None:
+                self.translog.trim_above(seq_no)
+
+    def fill_seqno_gaps(self, up_to: int) -> None:
+        """Advance the local checkpoint over seqnos collapsed away by
+        latest-op-per-doc replay (ops-based recovery / promotion no-op fill)."""
+        with self._lock:
+            self._seqno.fast_forward(up_to)
+
+    def relog_above(self, seq_no: int) -> None:
+        """Re-append the current op of every doc above seq_no to the translog.
+
+        After a resync trim, replayed ops can no-op against already-identical
+        in-memory entries (the stale-seqno check fires before translog.add),
+        leaving acked writes with no durable record. Re-logging the surviving
+        state above the trim point restores crash-recovery coverage."""
+        with self._lock:
+            if self.translog is None:
+                return
+            entries = sorted((e.seq_no, d) for d, e in self._versions.items()
+                             if e.seq_no > seq_no)
+            for _, doc_id in entries:
+                entry = self._versions[doc_id]
+                if entry.deleted:
+                    self.translog.add({"op": "delete", "id": doc_id,
+                                       "seq_no": entry.seq_no,
+                                       "primary_term": self.primary_term,
+                                       "version": entry.version})
+                else:
+                    if entry.in_buffer:
+                        source = self._buffer[doc_id][0].source
+                    else:
+                        source = self._segments[entry.seg_idx].sources[entry.ord]
+                    self.translog.add({"op": "index", "id": doc_id,
+                                       "seq_no": entry.seq_no,
+                                       "primary_term": self.primary_term,
+                                       "version": entry.version, "source": source})
+
     def _tombstone(self, seg_idx: int, ord_: int) -> None:
         self._live[seg_idx][ord_] = False
         self._live_epochs[seg_idx] += 1

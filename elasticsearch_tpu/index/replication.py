@@ -139,6 +139,11 @@ class ReplicationGroup:
                                        "primary_term": term})
         # phase2: replay anything that arrived while phase1 streamed
         with self._lock:
+            if copy.allocation_id not in self.replicas:
+                # a concurrent write failed this copy during phase1 — do not
+                # resurrect it into the in-sync set (its checkpoint would pin
+                # the global checkpoint at -1 with no copy behind it)
+                return
             gap_ops = self.primary.engine.changes_since(copy.engine.local_checkpoint)
             for op in gap_ops:
                 self._apply_to_copy(copy, {"op": op["op"], "id": op["id"],
@@ -146,6 +151,9 @@ class ReplicationGroup:
                                            "seq_no": op["seq_no"],
                                            "primary_term": term})
             copy.engine.refresh()
+            # latest-op-per-doc replay collapses superseded seqnos; fill the
+            # gaps so the copy's checkpoint reaches the replayed history's end
+            copy.engine.fill_seqno_gaps(self.primary.engine.max_seq_no)
             self.tracker.update_local_checkpoint(
                 copy.allocation_id, copy.engine.local_checkpoint)
             self.tracker.mark_in_sync(copy.allocation_id)
@@ -154,22 +162,47 @@ class ReplicationGroup:
 
     def promote(self, allocation_id: str) -> "ReplicationGroup":
         """Promote a replica to primary after primary loss. Returns the new
-        group; remaining replicas resync from the new primary."""
+        group; remaining replicas resync from the new primary.
+
+        Resync semantics (ref: index/shard/PrimaryReplicaSyncer.java + the
+        replica engine reset to the global checkpoint): each survivor first
+        adopts the new primary term — explicitly, so a fully-caught-up copy
+        that replays zero ops is still fenced against the deposed primary —
+        then rolls back any history above the old global checkpoint to the
+        new primary's authoritative per-doc state, then replays the new
+        primary's ops above that checkpoint."""
         with self._lock:
+            gcp = self.tracker.global_checkpoint
             new_primary = self.replicas.pop(allocation_id)
-            new_primary.engine.primary_term = self.primary.engine.primary_term + 1
+            new_term = self.primary.engine.primary_term + 1
+            new_primary.engine.advance_primary_term(new_term)
+            # promotion fills seqno gaps so the new primary's checkpoint
+            # reaches its max seqno (reference fills with no-ops)
+            new_primary.engine.fill_seqno_gaps(new_primary.engine.max_seq_no)
             group = ReplicationGroup(new_primary, self.on_replica_failure)
             survivors = dict(self.replicas)
         for aid, copy in survivors.items():
-            # primary/replica resync: replay the new primary's ops above the
-            # copy's local checkpoint so all copies converge on ITS history
-            ops = new_primary.engine.changes_since(copy.engine.local_checkpoint)
             try:
-                for op in ops:
+                copy.engine.advance_primary_term(new_term)
+                # roll back divergent ops the old primary replicated beyond
+                # the global checkpoint but the new primary never saw
+                for doc_id in copy.engine.docs_above(gcp):
+                    copy.engine.force_resync_doc(
+                        doc_id, new_primary.engine.doc_resync_state(doc_id))
+                # a copy still catching up (tracked, not yet in-sync) may be
+                # behind the global checkpoint — replay from wherever it is
+                replay_from = min(gcp, copy.engine.local_checkpoint)
+                copy.engine.reset_local_checkpoint(replay_from)
+                for op in new_primary.engine.changes_since(replay_from):
                     self._apply_to_copy(copy, {"op": op["op"], "id": op["id"],
                                                "source": op.get("source"),
                                                "seq_no": op["seq_no"],
-                                               "primary_term": new_primary.engine.primary_term})
+                                               "primary_term": new_term})
+                copy.engine.fill_seqno_gaps(new_primary.engine.max_seq_no)
+                # the trim dropped durable records above replay_from and the
+                # replay may have no-opped against identical entries — re-log
+                # so crash recovery still covers the resynced tail
+                copy.engine.relog_above(replay_from)
             except Exception as e:  # noqa: BLE001
                 group.on_replica_failure(aid, e)
                 continue

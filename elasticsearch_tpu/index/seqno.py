@@ -52,6 +52,21 @@ class LocalCheckpointTracker:
         with self._lock:
             return seq_no <= self._checkpoint or seq_no in self._pending
 
+    def fast_forward(self, seq_no: int) -> None:
+        """Mark every seqno <= seq_no processed in one step (the no-op gap
+        fill the reference performs on primary promotion and at the end of
+        ops-based recovery, where replayed history collapses superseded ops;
+        ref: index/shard/IndexShard.java primary-promotion no-op fill)."""
+        with self._lock:
+            if seq_no > self._checkpoint:
+                self._checkpoint = seq_no
+                self._pending = {s for s in self._pending if s > seq_no}
+                while self._checkpoint + 1 in self._pending:
+                    self._checkpoint += 1
+                    self._pending.remove(self._checkpoint)
+            if seq_no >= self._next_seq_no:
+                self._next_seq_no = seq_no + 1
+
 
 class ReplicationTracker:
     """Primary-side global-checkpoint computation over in-sync copies.

@@ -90,18 +90,49 @@ class Translog:
             if gen < generation:
                 os.remove(self._gen_path(gen))
 
+    def trim_above(self, seq_no: int) -> None:
+        """Logically discard ops with seq_no > seq_no from replay — a trim
+        marker record, honored in order during reads, so a resynced replica's
+        divergent tail cannot be resurrected by crash recovery (ref:
+        index/translog/Translog.java trimOperations, called when a replica
+        rolls back to the global checkpoint on primary failover)."""
+        self.add({"op": "trim", "above": seq_no})
+
     # ---- reads ----
 
     def read_ops(self, min_seq_no: int = -1) -> Iterator[Dict[str, Any]]:
         """Replay all ops with seq_no > min_seq_no across generations.
 
-        A torn final record (crash mid-write) is tolerated and ends replay of
-        that generation; a corrupt interior record raises.
+        Trim markers drop earlier-appended ops above their threshold, in log
+        order. Replay streams (constant memory): a cheap first pass collects
+        the trim markers' positions, the second pass yields ops, suppressing
+        any op a later trim covers. A torn final record (crash mid-write) is
+        tolerated and ends replay of that generation; a corrupt interior
+        record raises.
         """
         with self._lock:
             self._file.flush()
-        for gen in self.generations():
-            yield from self._read_gen(gen, min_seq_no)
+        gens = self.generations()
+        trims: List[tuple] = []  # (record_position, trim_above)
+        pos = 0
+        for gen in gens:
+            for op in self._read_gen(gen, -2):
+                if op.get("op") == "trim":
+                    trims.append((pos, op["above"]))
+                pos += 1
+        pos = 0
+        for gen in gens:
+            for op in self._read_gen(gen, -2):
+                i = pos
+                pos += 1
+                if op.get("op") == "trim":
+                    continue
+                seq = op.get("seq_no", -1)
+                if seq <= min_seq_no:
+                    continue
+                if any(t_pos > i and seq > above for t_pos, above in trims):
+                    continue
+                yield op
 
     def _read_gen(self, gen: int, min_seq_no: int) -> Iterator[Dict[str, Any]]:
         path = self._gen_path(gen)
@@ -122,7 +153,9 @@ class Translog:
                         f"translog corruption in generation {gen} at offset {f.tell()}"
                     )
                 op = json.loads(payload)
-                if op.get("seq_no", -1) > min_seq_no:
+                # trim markers always flow through: they affect replay even
+                # when their own record carries no seq_no
+                if op.get("op") == "trim" or op.get("seq_no", -1) > min_seq_no:
                     yield op
 
     def total_ops(self) -> int:

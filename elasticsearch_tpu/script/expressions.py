@@ -33,7 +33,7 @@ class ScriptException(ElasticsearchTpuError):
 _ALLOWED_FUNCS: Dict[str, Callable] = {
     "abs": abs, "min": min, "max": max, "round": round, "len": len,
     "floor": math.floor, "ceil": math.ceil, "sqrt": math.sqrt,
-    "log": math.log, "log10": math.log10, "exp": math.exp, "pow": pow,
+    "log": math.log, "log10": math.log10, "exp": math.exp,
     "sin": math.sin, "cos": math.cos, "tan": math.tan,
     "saturation": lambda v, k: v / (v + k),
     "sigmoid": lambda v, k, a: v ** a / (k ** a + v ** a),
@@ -69,6 +69,11 @@ def _safe_mult(a, b):
             if len(seq) * max(n, 0) > 100_000:
                 raise ScriptException("sequence repetition too large")
     return a * b
+
+
+# pow() must go through the same compute bound as the ** operator — the raw
+# builtin would let pow(2, 10**9) bypass the _GuardOps rewrite entirely
+_ALLOWED_FUNCS["pow"] = _safe_pow
 
 
 class _GuardOps(ast.NodeTransformer):

@@ -311,6 +311,37 @@ def test_isolated_node_catches_up_after_heal(seed):
 
 
 @pytest.mark.parametrize("seed", range(3))
+def test_follower_that_missed_only_the_commit_catches_up(seed):
+    """A follower that ACCEPTS a publish but never sees its commit must
+    converge: the leader's catch-up re-publish of the same (term, version) is
+    re-acked idempotently so the commit gets re-sent."""
+    c = SimCluster(["n0", "n1", "n2"], seed=seed)
+    c.start()
+    c.run(30_000)
+    leader = c.stable_leader()
+    victim = next(n for n in c.nodes if n != leader.node_id)
+    orig_send = c.transport.send
+    dropped = []
+
+    def send(sender, to, msg, on_reply, on_error=None):
+        if msg.get("type") == "commit" and to == victim:
+            dropped.append(msg)
+            return
+        orig_send(sender, to, msg, on_reply, on_error)
+
+    c.transport.send = send
+    value = {"missed_commit": True, "seed": seed}
+    leader.publish(value)
+    c.run(2_000)   # publish accepted everywhere; victim's commit swallowed
+    c.transport.send = orig_send
+    assert dropped, "test setup: no commit was dropped"
+    assert not any(s.value == value for s in c.committed[victim])
+    c.run(60_000)  # follower checks spot the lag and re-publish + commit
+    assert any(s.value == value for s in c.committed[victim]), \
+        "victim stuck: accepted state never committed"
+
+
+@pytest.mark.parametrize("seed", range(3))
 def test_isolated_leader_cannot_shrink_config_to_itself(seed):
     """Regression: an isolated leader's failed-follower reconfigurations must
     never commit (joint consensus anchors on the last COMMITTED config), and

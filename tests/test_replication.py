@@ -172,3 +172,45 @@ def test_primary_term_fencing_blocks_deposed_primary():
     # and the promoted group keeps working
     new_group.index("ok", {"n": 2})
     assert "ok" in doc_ids(r2.engine)
+
+
+def test_resync_divergence_rollback_and_crash_durability(tmp_path):
+    """A replica's divergent tail (replicated beyond the global checkpoint by
+    a lost primary) is rolled back to the new primary's history on promote —
+    and the rollback survives a crash-restart: the trim marker drops the
+    divergent translog records and the re-logged resync state replays."""
+
+    def durable_copy(node, path):
+        return ShardCopy(allocation_id=new_allocation_id(), node_id=node,
+                         engine=InternalEngine(MapperService(dict(MAPPING)),
+                                               data_path=str(path)))
+
+    primary = durable_copy("n0", tmp_path / "p")
+    r1 = durable_copy("n1", tmp_path / "r1")
+    r2 = durable_copy("n2", tmp_path / "r2")
+    group = ReplicationGroup(primary)
+    group.add_replica(r1)
+    group.add_replica(r2)
+    group.index("a", {"n": 1})
+    gcp = group.global_checkpoint
+
+    # the old primary replicates a write only to r2 (r1 missed it), then dies
+    op = primary.engine.index("diverged", {"n": 2})
+    r2.engine.index("diverged", {"n": 2}, seq_no=op.seq_no,
+                    op_primary_term=op.primary_term)
+    assert gcp < op.seq_no
+
+    # drop the old primary; promote r1 (which never saw "diverged")
+    group.replicas.pop(primary.allocation_id, None)
+    new_group = group.promote(r1.allocation_id)
+    assert "diverged" not in doc_ids(r2.engine)
+    assert doc_ids(r2.engine) == doc_ids(r1.engine) == {"a"}
+
+    # crash r2 and recover from disk: divergence must not resurrect
+    r2.engine.close()
+    recovered = InternalEngine(MapperService(dict(MAPPING)),
+                               data_path=str(tmp_path / "r2"))
+    assert doc_ids(recovered) == {"a"}
+    assert recovered.get("diverged") is None
+    # the surviving acked write is still durable
+    assert recovered.get("a")["_source"] == {"n": 1}

@@ -58,6 +58,12 @@ def test_compute_limits():
     assert compile_script("2**10").execute() == 1024
 
 
+def test_pow_function_is_bounded_like_pow_operator():
+    with pytest.raises(ScriptException):
+        compile_script("pow(2, 999999999)").execute()
+    assert compile_script("pow(2, 10)").execute() == 1024
+
+
 def test_params_attribute_access():
     assert compile_script("v * params.f").execute(
         {"v": 3, "params": {"f": 2}}) == 6
