@@ -71,9 +71,15 @@ class CoordinationError(Exception):
 
 
 class CoordinationState:
-    """Persisted consensus state of one node (ref: CoordinationState.java)."""
+    """Persisted consensus state of one node (ref: CoordinationState.java).
 
-    def __init__(self, node_id: str, initial: PublishedState):
+    `persistor(doc)` — when given — is invoked synchronously BEFORE any
+    safety-critical transition returns (vote cast, publish accepted, commit):
+    a restarted node must never vote twice in one term or forget an accepted
+    publication (ref: gateway/GatewayMetaState.java persisted-state wrapper).
+    """
+
+    def __init__(self, node_id: str, initial: PublishedState, persistor=None):
         self.node_id = node_id
         self.current_term = initial.term
         self.accepted = initial               # last accepted (maybe uncommitted)
@@ -86,6 +92,29 @@ class CoordinationState:
         self.election_won = False
         self.join_votes: Set[str] = set()
         self.publish_votes: Set[str] = set()
+        self.persistor = persistor
+
+    # ---- durability ----
+
+    def to_doc(self) -> dict:
+        return {"current_term": self.current_term,
+                "join_vote_term": self.join_vote_term,
+                "accepted": _state_to_wire(self.accepted),
+                "last_committed_version": self.last_committed_version,
+                "committed_config": sorted(self.committed_config)}
+
+    @classmethod
+    def from_doc(cls, node_id: str, doc: dict, persistor=None) -> "CoordinationState":
+        st = cls(node_id, _state_from_wire(doc["accepted"]), persistor)
+        st.current_term = doc["current_term"]
+        st.join_vote_term = doc["join_vote_term"]
+        st.last_committed_version = doc["last_committed_version"]
+        st.committed_config = frozenset(doc["committed_config"])
+        return st
+
+    def _persist(self) -> None:
+        if self.persistor is not None:
+            self.persistor(self.to_doc())
 
     # ---- term/vote handling ----
 
@@ -99,6 +128,7 @@ class CoordinationState:
         self.election_won = False
         self.join_votes = set()
         self.publish_votes = set()
+        self._persist()     # the vote must be durable before it is cast
         return Join(voter=self.node_id, target=target, term=term,
                     last_accepted_term=self.accepted.term,
                     last_accepted_version=self.accepted.version)
@@ -133,6 +163,7 @@ class CoordinationState:
         )
         self.publish_votes = set()
         self.accepted = st
+        self._persist()
         return st
 
     # ---- publication (any node) ----
@@ -146,6 +177,7 @@ class CoordinationState:
                 f"publish version {st.version} not newer than accepted "
                 f"{self.accepted.version}")
         self.accepted = st
+        self._persist()     # accepted state must survive restart before ack
         return PublishResponse(node_id=self.node_id, term=st.term, version=st.version)
 
     def handle_publish_response(self, resp: "PublishResponse") -> bool:
@@ -166,6 +198,7 @@ class CoordinationState:
         self.committed_config = self.accepted.config
         committed = replace(self.accepted, last_committed_config=self.accepted.config)
         self.accepted = committed
+        self._persist()
         return committed
 
 
@@ -202,9 +235,13 @@ class Coordinator:
     PUBLISH_TIMEOUT_MS = 30_000
 
     def __init__(self, node_id: str, initial: PublishedState, transport,
-                 scheduler, rng, on_commit: Callable[[PublishedState], None]):
+                 scheduler, rng, on_commit: Callable[[PublishedState], None],
+                 persistor=None, restored: Optional[dict] = None):
         self.node_id = node_id
-        self.state = CoordinationState(node_id, initial)
+        if restored is not None:
+            self.state = CoordinationState.from_doc(node_id, restored, persistor)
+        else:
+            self.state = CoordinationState(node_id, initial, persistor)
         self.transport = transport
         self.scheduler = scheduler
         self.rng = rng

@@ -1,0 +1,42 @@
+"""Durable coordination state: term, vote, accepted cluster state.
+
+The reference persists consensus-critical state in a local Lucene index
+(ref: gateway/PersistedClusterStateService.java:111, GatewayMetaState.java:68)
+so a restarted node cannot vote twice in one term or forget an accepted-but-
+uncommitted publication. Here the same contract is a fsynced JSON document
+with atomic replace — the state is small (term, vote, one cluster state) and
+write frequency is election/publication cadence, not the data path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+class PersistedCoordinationState:
+    """Load/store one node's (current_term, join_vote_term, accepted state,
+    last_committed_version)."""
+
+    FILENAME = "_coordination_state.json"
+
+    def __init__(self, data_path: Optional[str]):
+        self.path = os.path.join(data_path, self.FILENAME) if data_path else None
+
+    def load(self) -> Optional[dict]:
+        if self.path is None or not os.path.exists(self.path):
+            return None
+        with open(self.path) as f:
+            return json.load(f)
+
+    def store(self, doc: dict) -> None:
+        if self.path is None:
+            return
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)

@@ -243,6 +243,81 @@ class Segment:
         return total
 
 
+def build_field_postings(
+    field: str,
+    doc_lens: np.ndarray,      # [n_docs] token count per doc
+    token_docs: np.ndarray,    # [n_tokens] doc ord of each token
+    token_terms: np.ndarray,   # [n_tokens] term ord of each token
+    term_names: List[str],     # term ord -> term string (sorted)
+) -> FieldPostings:
+    """Columnar bulk postings build: token arrays -> block postings, fully
+    vectorized (the analog of Lucene's flush from sorted (term, doc) pairs,
+    ref: Lucene87 postings writer) — indexes millions of docs in seconds
+    where the per-doc builder path takes minutes. Positions are not recorded
+    (bulk-loaded fields serve match/term scoring; phrase needs the doc-at-a-
+    time builder)."""
+    n_docs = len(doc_lens)
+    n_terms = len(term_names)
+    # tf per (term, doc): unique over a combined key, sorted by term then doc
+    key = token_terms.astype(np.int64) * n_docs + token_docs.astype(np.int64)
+    uniq, tf = np.unique(key, return_counts=True)
+    term_ord = (uniq // n_docs).astype(np.int32)
+    doc_ord = (uniq % n_docs).astype(np.int32)
+    tf = tf.astype(np.float32)
+
+    doc_freq = np.bincount(term_ord, minlength=n_terms).astype(np.int32)
+    n_blocks_per_term = (doc_freq + BLOCK - 1) // BLOCK
+    block_start = np.zeros(n_terms, np.int32)
+    block_start[0] = 1                        # row 0 reserved zero block
+    np.cumsum(n_blocks_per_term[:-1], out=block_start[1:])
+    block_start[1:] += 1
+    total_blocks = 1 + int(n_blocks_per_term.sum())
+
+    # lane placement: position of each posting within its term's run
+    term_offsets = np.zeros(n_terms + 1, np.int64)
+    np.cumsum(doc_freq, out=term_offsets[1:])
+    within = np.arange(len(uniq), dtype=np.int64) - term_offsets[term_ord]
+    row = block_start[term_ord] + (within // BLOCK).astype(np.int32)
+    lane = (within % BLOCK).astype(np.int32)
+
+    block_docs = np.zeros((total_blocks, BLOCK), np.int32)
+    block_tfs = np.zeros((total_blocks, BLOCK), np.float32)
+    block_docs[row, lane] = doc_ord
+    block_tfs[row, lane] = tf
+    block_max_tf = np.zeros(total_blocks, np.float32)
+    if len(uniq):
+        # lanes are laid out in order, so each block is a contiguous run
+        # starting where lane == 0 — segmented max via reduceat
+        starts = np.nonzero(lane == 0)[0]
+        block_max_tf[row[starts]] = np.maximum.reduceat(tf, starts)
+
+    post_start = np.zeros(n_terms + 1, np.int64)
+    post_start[1:] = term_offsets[1:]
+    total_tf = np.zeros(n_terms, np.int64)
+    nz = doc_freq > 0
+    if nz.any():
+        total_tf[nz] = np.add.reduceat(tf.astype(np.int64), term_offsets[:-1][nz])
+
+    return FieldPostings(
+        field=field,
+        term_to_ord={t: i for i, t in enumerate(term_names)},
+        terms=list(term_names),
+        doc_freq=doc_freq,
+        total_term_freq=total_tf,
+        block_start=block_start,
+        block_count=n_blocks_per_term.astype(np.int32),
+        block_docs=block_docs,
+        block_tfs=block_tfs,
+        block_max_tf=block_max_tf,
+        post_start=post_start,
+        post_doc=doc_ord,
+        pos_start=np.zeros(len(uniq) + 1, np.int64),
+        pos_data=np.empty(0, np.int32),
+        doc_len=doc_lens.astype(np.float32),
+        sum_doc_len=float(doc_lens.sum()),
+    )
+
+
 class SegmentBuilder:
     """Accumulates parsed docs and freezes them into a Segment.
 

@@ -67,9 +67,9 @@ class StackedBM25:
 
     field: str
     block_docs: jax.Array       # [S, T, 128] i32 (device, sharded over 'shard')
-    block_tfs: jax.Array        # [S, T, 128] f32
+    block_tfs: jax.Array | None  # [S, T, 128] f32 (None when serve_only)
     block_scores: jax.Array     # [S, T, 128] f32 — idf-free lane score tf(k1+1)/(tf+norm)
-    doc_len: jax.Array          # [S, D] f32
+    doc_len: jax.Array | None   # [S, D] f32 (None when serve_only)
     live: jax.Array             # [S, D] bool
     n_shards: int
     max_docs: int               # D (padded)
@@ -77,6 +77,9 @@ class StackedBM25:
     avgdl: float                # global average doc length
     total_docs: int             # global doc count (idf denominator)
     postings: List[FieldPostings]  # host metadata per shard (term -> blocks)
+    block_max_scores: List[np.ndarray] | None = None  # host [T_s] per shard:
+    #   max idf-free lane score per block — the block-max culling metadata
+    #   (SURVEY §5.7: the BlockMaxWAND analog's skip data)
 
     def sharding(self, mesh: Mesh):
         return NamedSharding(mesh, P(None, "shard"))
@@ -107,6 +110,7 @@ def build_stacked_bm25(
     field: str,
     live_masks: Sequence[np.ndarray] | None = None,
     mesh: Mesh | None = None,
+    serve_only: bool = False,
 ) -> StackedBM25:
     """Stack per-shard single segments into shardable arrays.
 
@@ -155,14 +159,15 @@ def build_stacked_bm25(
         dl_lane[s] = doc_len[s][block_docs[s]]
     denom = block_tfs + K1 * (1.0 - B + B * dl_lane / max(avgdl, 1e-9))
     block_scores = np.where(block_tfs > 0, block_tfs * (K1 + 1.0) / denom, 0.0).astype(np.float32)
+    block_max_scores = [block_scores[s].max(axis=1) for s in range(S)]
 
     put = partial(_put_sharded, mesh=mesh)
     return StackedBM25(
         field=field,
         block_docs=put(block_docs),
-        block_tfs=put(block_tfs),
+        block_tfs=None if serve_only else put(block_tfs),
         block_scores=put(block_scores),
-        doc_len=put(doc_len),
+        doc_len=None if serve_only else put(doc_len),
         live=put(live),
         n_shards=S,
         max_docs=D,
@@ -170,6 +175,7 @@ def build_stacked_bm25(
         avgdl=float(avgdl),
         total_docs=total_docs,
         postings=fps,
+        block_max_scores=block_max_scores,
     )
 
 

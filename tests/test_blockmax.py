@@ -1,0 +1,179 @@
+"""Block-max culled serving path: exactness vs the exhaustive SPMD path.
+
+The culled two-pass executor must return IDENTICAL top-k (scores and docs)
+to scoring every block — the parity bar BASELINE.md sets ("identical top-10
+hits"). Exercised over Zipfian corpora where culling actually skips most
+blocks, on single-shard and multi-shard meshes, with hot (dense-column) and
+cold terms mixed.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.segment import build_field_postings
+from elasticsearch_tpu.parallel import (
+    build_stacked_bm25, make_mesh, prepare_query_blocks, sharded_bm25_topk,
+)
+from elasticsearch_tpu.parallel.blockmax import BlockMaxBM25
+
+VOCAB = 300
+N_DOCS = 3000
+
+
+def zipf_corpus(rng, n_docs, n_shards):
+    probs = 1.0 / np.arange(1, VOCAB + 1) ** 1.07
+    probs /= probs.sum()
+    lens = rng.integers(4, 40, size=n_docs).astype(np.int64)
+    terms = rng.choice(VOCAB, size=int(lens.sum()), p=probs)
+    shard_of = rng.integers(0, n_shards, size=n_docs)
+    names = [f"t{i}" for i in range(VOCAB)]
+    segments = []
+    for s in range(n_shards):
+        mask = shard_of == s
+        doc_lens = lens[mask]
+        # token -> local doc ord
+        tok_doc_global = np.repeat(np.arange(n_docs), lens)
+        tok_mask = mask[tok_doc_global]
+        local_ord = np.cumsum(mask) - 1
+        tok_docs = local_ord[tok_doc_global[tok_mask]]
+        fp = build_field_postings("body", doc_lens, tok_docs.astype(np.int64),
+                                  terms[tok_mask].astype(np.int64), names)
+
+        class _Seg:
+            pass
+
+        seg = _Seg()
+        seg.n_docs = int(mask.sum())
+        seg.postings = {"body": fp}
+        segments.append(seg)
+    return segments
+
+
+def draw_queries(rng, n, n_terms=(1, 2, 3)):
+    qprobs = 1.0 / np.arange(1, VOCAB + 1) ** 1.07
+    qprobs /= qprobs.sum()
+    out = []
+    for _ in range(n):
+        m = int(rng.choice(n_terms))
+        out.append([f"t{t}" for t in rng.choice(VOCAB, size=m, p=qprobs,
+                                                replace=False)])
+    return out
+
+
+@pytest.mark.parametrize("n_shards,dp", [(1, 1), (4, 2)])
+def test_blockmax_matches_exhaustive(n_shards, dp):
+    rng = np.random.default_rng(17)
+    segments = zipf_corpus(rng, N_DOCS, n_shards)
+    mesh = make_mesh(n_shards * dp, dp=dp)
+    stacked = build_stacked_bm25(segments, "body", mesh=mesh)
+    serving = BlockMaxBM25(stacked, mesh)
+    queries = draw_queries(rng, 40)
+
+    ref_s, ref_sh, ref_o = sharded_bm25_topk(
+        mesh, stacked, *prepare_query_blocks(stacked, queries), k=10)
+    got_s, got_sh, got_o = serving.search(queries, k=10)
+
+    for q in range(len(queries)):
+        # same scores to f32 tolerance
+        np.testing.assert_allclose(got_s[q], ref_s[q], rtol=2e-5, atol=2e-5)
+        # same doc set wherever scores are distinct (ties may permute)
+        ref_docs = {(int(sh), int(o)) for sh, o, s in
+                    zip(ref_sh[q], ref_o[q], ref_s[q]) if s > -np.inf}
+        got_docs = {(int(sh), int(o)) for sh, o, s in
+                    zip(got_sh[q], got_o[q], got_s[q]) if s > -np.inf}
+        distinct = len(np.unique(np.round(ref_s[q][ref_s[q] > -np.inf], 4)))
+        if distinct == (ref_s[q] > -np.inf).sum():
+            assert got_docs == ref_docs, f"query {q}: {queries[q]}"
+
+
+def test_blockmax_culls_blocks():
+    """A frequent term's low-impact blocks must be dropped when a rare term
+    sets the bar — the dynamic-pruning behavior SURVEY §5.7 calls for."""
+    n_docs = 20_000
+    lens = np.full(n_docs, 10, np.int64)
+    tok_docs, tok_terms = [], []
+    rng = np.random.default_rng(11)
+    for d in range(n_docs):
+        toks = []
+        if d % 8 == 0 and d < 19200:          # 2400 docs with "common" (tf 1)
+            toks.append(0)
+        if 960 <= d < 1088 and d % 8 == 0:    # 16 of them with tf 8
+            toks.extend([0] * 7)
+        if 4000 <= d < 4020:                  # 20 docs with "rare"
+            toks.append(1)
+        while len(toks) < 10:
+            toks.append(2 + int(rng.integers(0, 5000)))
+        tok_docs.extend([d] * 10)
+        tok_terms.extend(toks[:10])
+    names = ["common", "rare"] + [f"f{i}" for i in range(5000)]
+    from elasticsearch_tpu.index.segment import build_field_postings
+
+    fp = build_field_postings("body", lens, np.asarray(tok_docs, np.int64),
+                              np.asarray(tok_terms, np.int64), names)
+
+    class _Seg:
+        pass
+
+    seg = _Seg()
+    seg.n_docs = n_docs
+    seg.postings = {"body": fp}
+    mesh = make_mesh(1, dp=1)
+    stacked = build_stacked_bm25([seg], "body", mesh=mesh)
+    serving = BlockMaxBM25(stacked, mesh)
+
+    scores, _, _ = serving.search([["common", "rare"]], k=10)
+    mc = serving._terms["common"]
+    assert mc.hot_slot < 0, "common unexpectedly classified hot"
+    n_blocks = len(mc.blocks[0].ids)
+    assert n_blocks >= 15
+    sel, max_total = serving._select(
+        [[("common", 1.0), ("rare", 1.0)]],
+        np.asarray([scores[0][-1]], np.float32))
+    kept = int(sel[0]["common"][0].sum())
+    # only the tf-8 block(s) and the block(s) overlapping rare's doc range
+    # may survive; the tf-1 bulk must be culled
+    assert kept < n_blocks // 2, f"kept {kept} of {n_blocks} common blocks"
+
+
+def test_fast_postings_builder_matches_slow():
+    """build_field_postings must agree with the per-doc SegmentBuilder."""
+    from elasticsearch_tpu.index.segment import SegmentBuilder
+    from elasticsearch_tpu.mapper.mapper_service import LuceneDoc
+
+    rng = np.random.default_rng(5)
+    n_docs, vocab = 200, 30
+    lens = rng.integers(1, 20, size=n_docs).astype(np.int64)
+    terms = rng.choice(vocab, size=int(lens.sum()))
+    names = [f"t{i:03d}" for i in range(vocab)]   # zero-padded: sorted order
+
+    fast = build_field_postings(
+        "body", lens, np.repeat(np.arange(n_docs), lens).astype(np.int64),
+        terms.astype(np.int64), names)
+
+    builder = SegmentBuilder()
+    off = 0
+    for i in range(n_docs):
+        n = int(lens[i])
+        vals, counts = np.unique(terms[off:off + n], return_counts=True)
+        off += n
+        doc = LuceneDoc(doc_id=str(i), source={})
+        doc.inverted["body"] = [(names[v], list(range(int(c))))
+                                for v, c in zip(vals, counts)]
+        doc.field_lengths["body"] = n
+        builder.add(doc, seq_no=i)
+    slow = builder.build().postings["body"]
+
+    used = [i for i in range(vocab) if fast.doc_freq[i] > 0]
+    assert [names[i] for i in used] == slow.terms
+    for i, t in zip(used, slow.terms):
+        np.testing.assert_array_equal(fast.term_block_ids(names[i]) > 0,
+                                      slow.term_block_ids(t) > 0)
+        o_f, o_s = fast.term_to_ord[t], slow.term_to_ord[t]
+        assert fast.doc_freq[o_f] == slow.doc_freq[o_s]
+        assert fast.total_term_freq[o_f] == slow.total_term_freq[o_s]
+        fb = fast.term_block_ids(t)
+        sb = slow.term_block_ids(t)
+        np.testing.assert_array_equal(fast.block_docs[fb], slow.block_docs[sb])
+        np.testing.assert_array_equal(fast.block_tfs[fb], slow.block_tfs[sb])
+        np.testing.assert_array_equal(fast.block_max_tf[fb], slow.block_max_tf[sb])
+    np.testing.assert_array_equal(fast.doc_len, slow.doc_len)
