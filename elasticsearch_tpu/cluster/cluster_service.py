@@ -255,15 +255,29 @@ class ClusterFormationService:
         if self.is_leader:
             with self._update_lock:
                 new_value = updater(self.coordinator.state.accepted.value)
-                version_before = self.coordinator.state.last_committed_version
-                self.coordinator.publish(new_value)
-            deadline = time.time() + timeout
-            while time.time() < deadline:
-                if self.coordinator.state.last_committed_version > version_before:
-                    return self.coordinator.state.accepted.value
-                time.sleep(0.02)
-            raise ElasticsearchTpuError("cluster state publication timed out")
+                pub_term, pub_version = self.coordinator.publish(new_value)
+            self._await_commit(pub_term, pub_version, timeout)
+            return self.coordinator.state.accepted.value
         raise NotMasterError(self.leader_name)
+
+    def _await_commit(self, pub_term: int, pub_version: int, timeout: float) -> None:
+        """Wait for THE publication identified by (term, version) to commit.
+
+        Waiting for any commit would ack a write that a new leader's
+        unrelated commit satisfied (ref: MasterService publication listeners
+        are per-publication; a term bump fails in-flight publications)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            st = self.coordinator.state
+            if st.last_committed_version >= pub_version \
+                    and st.accepted.term == pub_term:
+                return
+            if st.current_term != pub_term:
+                raise ElasticsearchTpuError(
+                    "cluster state publication failed: term changed "
+                    f"({pub_term} -> {st.current_term})")
+            time.sleep(0.02)
+        raise ElasticsearchTpuError("cluster state publication timed out")
 
     def _on_forwarded_update(self, req) -> dict:
         """Leader-side handler for follower-forwarded whole-value updates."""
@@ -271,14 +285,9 @@ class ClusterFormationService:
             raise NotMasterError(self.leader_name)
         new_value = req.payload["value"]
         with self._update_lock:
-            version_before = self.coordinator.state.last_committed_version
-            self.coordinator.publish(new_value)
-        deadline = time.time() + 30.0
-        while time.time() < deadline:
-            if self.coordinator.state.last_committed_version > version_before:
-                return {"ok": True}
-            time.sleep(0.02)
-        raise ElasticsearchTpuError("cluster state publication timed out")
+            pub_term, pub_version = self.coordinator.publish(new_value)
+        self._await_commit(pub_term, pub_version, 30.0)
+        return {"ok": True}
 
     def _on_commit(self, st: PublishedState) -> None:
         try:

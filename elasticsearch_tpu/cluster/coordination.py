@@ -471,8 +471,11 @@ class Coordinator:
 
     # ---- publication ----
 
-    def publish(self, value: Any, new_config: Optional[frozenset] = None) -> None:
-        """Leader: replicate a new state (ref: Coordinator.publish)."""
+    def publish(self, value: Any, new_config: Optional[frozenset] = None) -> tuple:
+        """Leader: replicate a new state (ref: Coordinator.publish).
+
+        Returns the publication's (term, version) so callers can await THIS
+        publication's commit rather than any concurrent commit."""
         if self.mode != LEADER:
             raise CoordinationError("not the leader")
         st = self.state.handle_client_value(value, new_config)
@@ -496,7 +499,7 @@ class Coordinator:
             own = PublishResponse(self.node_id, st.term, st.version)
             ready = self.state.handle_publish_response(own)
         except CoordinationError:
-            return
+            return (st.term, st.version)
         for peer in self._peers(st):
             self.transport.send(self.node_id, peer,
                                 {"type": "publish", "state": wire},
@@ -514,6 +517,7 @@ class Coordinator:
                 self._become_candidate("publication timed out")
 
         self.scheduler.schedule_at(self.PUBLISH_TIMEOUT_MS, on_timeout)
+        return (st.term, st.version)
 
     def _broadcast_commit(self, st: PublishedState) -> None:
         try:

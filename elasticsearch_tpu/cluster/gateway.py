@@ -40,3 +40,12 @@ class PersistedCoordinationState:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        # fsync the directory so the rename itself is durable — without it a
+        # crash can forget a cast vote, the exact contract this module exists
+        # to provide (ref: gateway/PersistedClusterStateService.java fsyncs
+        # the state directory after commit)
+        dir_fd = os.open(os.path.dirname(self.path), os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)

@@ -203,6 +203,15 @@ class ReplicationGroup:
                 # replay may have no-opped against identical entries — re-log
                 # so crash recovery still covers the resynced tail
                 copy.engine.relog_above(replay_from)
+                # a divergent op already FLUSHED into a committed segment is
+                # only tombstoned in memory by the rollback above; the
+                # on-disk commit's live mask would resurrect it on crash
+                # recovery (its seqno can sit below the committed checkpoint,
+                # out of translog-replay range). Re-commit so the durable
+                # state matches the rolled-back state before promote returns
+                # (ref: the reference resets replicas to a safe commit whose
+                # max_seq_no <= global checkpoint, then re-commits)
+                copy.engine.flush()
             except Exception as e:  # noqa: BLE001
                 group.on_replica_failure(aid, e)
                 continue

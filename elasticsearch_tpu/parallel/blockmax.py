@@ -52,6 +52,8 @@ PASS_A_BLOCKS = 8       # blocks per query in the theta-estimation pass
 # padding of thousands of light ones. Compile cache: one program per pair.
 _GROUP_SHAPES = [(8, 512), (32, 512), (128, 256), (512, 64),
                  (2048, 16), (8192, 8), (32768, 4)]
+_MAX_BUCKET = _GROUP_SHAPES[-1][0]
+_OVERFLOW_CHUNK = 8192   # blocks per scatter-add dispatch on the overflow path
 
 
 def _group_shape(n_blocks: int):
@@ -209,6 +211,17 @@ class BlockMaxBM25:
                         b = sb.ids if mask is None else sb.ids[mask]
                     n = len(b)
                     if offs[s] + n > bucket:
+                        if selections is not None:
+                            # pass-B truncation would drop blocks the culling
+                            # proof requires — such queries must take the
+                            # overflow path (ADVICE r2: this used to silently
+                            # return inexact results)
+                            raise RuntimeError(
+                                f"blockmax bucket overflow: {offs[s] + n} kept "
+                                f"blocks > bucket {bucket}; query should have "
+                                "been routed to the exhaustive overflow path")
+                        # pass-A truncation only weakens theta (a smaller
+                        # partial top-k lower bound), never exactness
                         n = bucket - offs[s]
                         b = b[:n]
                     qblocks[qi, s, offs[s]: offs[s] + n] = b
@@ -350,8 +363,15 @@ class BlockMaxBM25:
             totals[qi] = per_shard.max()
 
         groups: Dict[Tuple[int, int], List[int]] = {}
+        overflow: List[int] = []
         for qi, tot in enumerate(totals):
-            groups.setdefault(_group_shape(int(tot)), []).append(qi)
+            if int(tot) > _MAX_BUCKET:
+                # more surviving blocks than the largest dispatch bucket:
+                # bucketed assembly would have to drop blocks (inexact) —
+                # take the chunked scatter-add path instead
+                overflow.append(qi)
+            else:
+                groups.setdefault(_group_shape(int(tot)), []).append(qi)
 
         pending = []   # (query_indices, packed)
         for (bucket, qc), members in sorted(groups.items()):
@@ -374,15 +394,18 @@ class BlockMaxBM25:
                 pending.append((idxs, packed_b))
 
         # one transfer: all groups' packed results (flattened; ragged shapes)
-        flat_out = np.asarray(jnp.concatenate(
-            [p.reshape(-1, 3 * k) for _, p in pending], axis=0))
         out_all = np.zeros((len(flat), 3, k), np.float32)
-        row = 0
-        for idxs, p in pending:
-            n_rows = p.shape[0]
-            grp_out = flat_out[row: row + n_rows].reshape(n_rows, 3, k)
-            row += n_rows
-            out_all[idxs] = grp_out[: len(idxs)]
+        if pending:
+            flat_out = np.asarray(jnp.concatenate(
+                [p.reshape(-1, 3 * k) for _, p in pending], axis=0))
+            row = 0
+            for idxs, p in pending:
+                n_rows = p.shape[0]
+                grp_out = flat_out[row: row + n_rows].reshape(n_rows, 3, k)
+                row += n_rows
+                out_all[idxs] = grp_out[: len(idxs)]
+        for qi in overflow:
+            out_all[qi] = self._exhaustive_topk(flat[qi], selections[qi], k)
 
         results = []
         for bi, start, n in spans:
@@ -390,6 +413,66 @@ class BlockMaxBM25:
             results.append((packed[:, 0], packed[:, 1].view(np.int32),
                             packed[:, 2].view(np.int32)))
         return results
+
+    def _exhaustive_topk(self, terms: List[Tuple[str, float]],
+                         selection: Dict[str, List[np.ndarray] | None],
+                         k: int) -> np.ndarray:
+        """Exact fallback for block-heavy queries: chunked scatter-add of
+        every kept block's lanes into a per-shard dense [D] accumulator, then
+        one top-k. No bucket truncation can occur, so exactness holds for any
+        surviving-block count; cost is O(kept blocks) dispatches of fixed
+        shape plus one [S, D] accumulator (ADVICE r2: the bucketed path used
+        to silently drop blocks past the largest bucket). Returns packed
+        [3, k] (score, shard bitcast, ord bitcast) like the bucketed path."""
+        S = self.S
+        per_shard: List[List[Tuple[np.ndarray, float]]] = [[] for _ in range(S)]
+        W = np.zeros((1, self.n_hot_slots), np.float32)
+        for t, boost in terms:
+            m = self._terms.get(t)
+            if m is None:
+                continue
+            w = m.idf * boost
+            if m.hot_slot >= 0:
+                W[0, m.hot_slot] += w
+                continue
+            masks = selection.get(t)
+            for s in range(S):
+                sb = m.blocks[s]
+                if not len(sb.ids):
+                    continue
+                mask = None if masks is None else masks[s]
+                b = sb.ids if mask is None else sb.ids[mask]
+                if len(b):
+                    per_shard[s].append((b, w))
+        ids_ws = []
+        n_chunks = 1
+        for s in range(S):
+            if per_shard[s]:
+                ids = np.concatenate([b for b, _ in per_shard[s]])
+                ws = np.concatenate([np.full(len(b), w, np.float32)
+                                     for b, w in per_shard[s]])
+            else:
+                ids = np.empty(0, np.int32)
+                ws = np.empty(0, np.float32)
+            ids_ws.append((ids, ws))
+            n_chunks = max(n_chunks, -(-len(ids) // _OVERFLOW_CHUNK))
+        acc = jax.jit(
+            lambda: jnp.zeros((S, self.D), jnp.float32),
+            out_shardings=NamedSharding(self.mesh, P("shard")))()
+        for c in range(n_chunks):
+            qb = np.zeros((S, _OVERFLOW_CHUNK), np.int32)
+            qw = np.zeros((S, _OVERFLOW_CHUNK), np.float32)
+            for s, (ids, ws) in enumerate(ids_ws):
+                seg = slice(c * _OVERFLOW_CHUNK, (c + 1) * _OVERFLOW_CHUNK)
+                part = ids[seg]
+                qb[s, : len(part)] = part
+                qw[s, : len(part)] = ws[seg]
+            acc = _scatter_chunk(
+                self.stacked.block_docs, self.stacked.block_scores, acc,
+                jnp.asarray(qb), jnp.asarray(qw), mesh=self.mesh)
+        packed = _acc_topk(acc, self.hot_cols, self.stacked.live,
+                           jnp.asarray(W), mesh=self.mesh, k=k)
+        return np.asarray(packed)[0]
 
     def _is_sparse(self, term: str) -> bool:
         meta = self._terms.get(term)
@@ -451,6 +534,54 @@ def _one_query_topk(d, s, dense, live, k):
     final = jnp.where(first & (ms2 > -jnp.inf), ms2, -jnp.inf)
     top_s, ti = jax.lax.top_k(final, k)
     return top_s, jnp.take(md2, ti)
+
+
+@partial(jax.jit, static_argnames=("mesh",), donate_argnums=(2,))
+def _scatter_chunk(block_docs, block_scores, acc, qb, qw, *, mesh):
+    """Overflow path, accumulate step: add one chunk of kept blocks' lane
+    scores into the per-shard dense accumulator. Pad slots carry weight 0 so
+    they contribute nothing (block 0's lanes get +0)."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard")),
+        out_specs=P("shard"), check_vma=False)
+    def program(bd, bs, acc, qb, qw):
+        docs = jnp.take(bd[0], qb[0], axis=0)            # [C, 128]
+        sc = qw[0][:, None] * jnp.take(bs[0], qb[0], axis=0)
+        return acc[0].at[docs.ravel()].add(sc.ravel())[None]
+
+    return program(block_docs, block_scores, acc, qb, qw)
+
+
+@partial(jax.jit, static_argnames=("mesh", "k"))
+def _acc_topk(acc, hot_cols, live, W, *, mesh, k):
+    """Overflow path, final step: sparse accumulator + dense hot matmul ->
+    exact merged top-k, packed [1, 3, k] (same candidate rule as
+    _one_query_topk: live and (some sparse lane or some hot contribution))."""
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P()),
+        out_specs=P(), check_vma=False)
+    def program(acc, hc, lv, W):
+        dense = jax.lax.dot_general(                     # [1, D]
+            W, hc[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+        a = acc[0][None]
+        tot = a + dense
+        ok = lv[0][None] & ((a > 0) | (dense > 0))
+        s, o = jax.lax.top_k(jnp.where(ok, tot, -jnp.inf), k)
+        g_s = jax.lax.all_gather(s, "shard")             # [S, 1, k]
+        g_o = jax.lax.all_gather(o.astype(jnp.int32), "shard")
+        top_s, shard_of, ord_of = _merge_gathered(g_s, g_o, k)
+        return jnp.stack(
+            [top_s,
+             jax.lax.bitcast_convert_type(shard_of, jnp.float32),
+             jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
+
+    return program(acc, hot_cols, live, W)
 
 
 @partial(jax.jit, static_argnames=("mesh", "k"))

@@ -177,3 +177,36 @@ def test_fast_postings_builder_matches_slow():
         np.testing.assert_array_equal(fast.block_tfs[fb], slow.block_tfs[sb])
         np.testing.assert_array_equal(fast.block_max_tf[fb], slow.block_max_tf[sb])
     np.testing.assert_array_equal(fast.doc_len, slow.doc_len)
+
+
+def test_overflow_path_matches_exhaustive(monkeypatch):
+    """Queries whose surviving blocks exceed the largest dispatch bucket must
+    take the chunked scatter-add overflow path and stay EXACT (ADVICE r2: the
+    bucketed path used to silently truncate kept blocks). Forced by shrinking
+    the bucket ladder so ordinary queries overflow."""
+    import elasticsearch_tpu.parallel.blockmax as bm
+
+    rng = np.random.default_rng(23)
+    segments = zipf_corpus(rng, N_DOCS, 2)
+    mesh = make_mesh(2, dp=1)
+    stacked = build_stacked_bm25(segments, "body", mesh=mesh)
+    queries = draw_queries(rng, 12)
+
+    ref_s, ref_sh, ref_o = sharded_bm25_topk(
+        mesh, stacked, *prepare_query_blocks(stacked, queries), k=10)
+
+    monkeypatch.setattr(bm, "_GROUP_SHAPES", [(8, 512)])
+    monkeypatch.setattr(bm, "_MAX_BUCKET", 8)
+    monkeypatch.setattr(bm, "_OVERFLOW_CHUNK", 16)
+    serving = BlockMaxBM25(stacked, mesh)
+    got_s, got_sh, got_o = serving.search(queries, k=10)
+
+    for q in range(len(queries)):
+        np.testing.assert_allclose(got_s[q], ref_s[q], rtol=2e-5, atol=2e-5)
+        ref_docs = {(int(sh), int(o)) for sh, o, s in
+                    zip(ref_sh[q], ref_o[q], ref_s[q]) if s > -np.inf}
+        got_docs = {(int(sh), int(o)) for sh, o, s in
+                    zip(got_sh[q], got_o[q], got_s[q]) if s > -np.inf}
+        distinct = len(np.unique(np.round(ref_s[q][ref_s[q] > -np.inf], 4)))
+        if distinct == (ref_s[q] > -np.inf).sum():
+            assert got_docs == ref_docs, f"query {q}: {queries[q]}"

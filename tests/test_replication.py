@@ -214,3 +214,48 @@ def test_resync_divergence_rollback_and_crash_durability(tmp_path):
     assert recovered.get("diverged") is None
     # the surviving acked write is still durable
     assert recovered.get("a")["_source"] == {"n": 1}
+
+
+def test_resync_rollback_of_flushed_divergence_survives_crash(tmp_path):
+    """ADVICE r2 (medium): when the divergent op was already FLUSHED into a
+    committed segment, rollback must not depend on the translog trim — the
+    commit's live mask would resurrect the doc on crash recovery. Promote
+    re-commits the rolled-back state, so restart converges."""
+
+    def durable_copy(node, path):
+        return ShardCopy(allocation_id=new_allocation_id(), node_id=node,
+                         engine=InternalEngine(MapperService(dict(MAPPING)),
+                                               data_path=str(path)))
+
+    primary = durable_copy("n0", tmp_path / "p")
+    r1 = durable_copy("n1", tmp_path / "r1")
+    r2 = durable_copy("n2", tmp_path / "r2")
+    group = ReplicationGroup(primary)
+    group.add_replica(r1)
+    group.add_replica(r2)
+    group.index("a", {"n": 1})
+    gcp = group.global_checkpoint
+
+    # old primary replicates a write only to r2, which FLUSHES it into a
+    # committed segment (live mask on disk now covers the divergent doc,
+    # and its seqno is <= the committed local checkpoint)
+    op = primary.engine.index("diverged", {"n": 2})
+    r2.engine.index("diverged", {"n": 2}, seq_no=op.seq_no,
+                    op_primary_term=op.primary_term)
+    r2.engine.flush()
+    assert gcp < op.seq_no
+
+    group.replicas.pop(primary.allocation_id, None)
+    new_group = group.promote(r1.allocation_id)
+    assert doc_ids(r2.engine) == {"a"}
+
+    # crash r2 and recover purely from disk: the divergent doc must stay dead
+    r2.engine.close()
+    recovered = InternalEngine(MapperService(dict(MAPPING)),
+                               data_path=str(tmp_path / "r2"))
+    assert doc_ids(recovered) == {"a"}
+    assert recovered.get("diverged") is None
+    assert recovered.get("a")["_source"] == {"n": 1}
+
+    # post-promote writes on the recovered state still apply cleanly
+    assert new_group.index("b", {"n": 3}).result == "created"
