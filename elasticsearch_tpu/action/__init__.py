@@ -1,0 +1,1 @@
+"""Distributed action layer (ref: server/.../action/)."""

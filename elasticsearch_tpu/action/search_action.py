@@ -1,0 +1,220 @@
+"""Distributed search: query-then-fetch scatter-gather over the transport.
+
+Re-designs the reference's search coordination (ref:
+action/search/AbstractSearchAsyncAction.java:188 per-shard query fan-out,
+action/search/FetchSearchPhase.java:94 fetch of winning docs from owning
+shards, action/search/SearchPhaseController.java:397 reduced merge;
+SearchTransportService.java:70 action names). The per-shard executor is the
+device path (query_phase over TPU segments); this module is the host
+control plane moving ids and scores between nodes.
+
+Wire format: shard query results serialize hits as plain dicts; aggregation
+partials (numpy-bearing monoid objects) travel pickled+base64 — they are
+internal node-to-node payloads exactly like the reference's
+InternalAggregations Writeables.
+"""
+
+from __future__ import annotations
+
+import base64
+import pickle
+import time
+from typing import Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError, IndexNotFoundError
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.indices.shard_service import DistributedShardService
+from elasticsearch_tpu.search.fetch_phase import execute_fetch_phase
+from elasticsearch_tpu.search.query_phase import (
+    QuerySearchResult, ShardHit, _sort_key, execute_query_phase, parse_sort,
+)
+from elasticsearch_tpu.search.reader_context import ReaderContextRegistry
+from elasticsearch_tpu.transport.channels import NodeChannels
+from elasticsearch_tpu.transport.service import TransportService
+
+ACTION_QUERY = "indices:data/read/search[phase/query]"
+ACTION_FETCH = "indices:data/read/search[phase/fetch/id]"
+ACTION_FREE = "indices:data/read/search[free_context]"
+
+
+def _py(v):
+    """numpy scalar -> python for JSON transport."""
+    if hasattr(v, "item"):
+        return v.item()
+    return v
+
+
+class SearchActionService:
+    """Shard-level query/fetch handlers + the coordinator entrypoint."""
+
+    def __init__(self, transport: TransportService, channels: NodeChannels,
+                 shard_service: DistributedShardService):
+        self.channels = channels
+        self.shards = shard_service
+        self.contexts = ReaderContextRegistry()
+        transport.register_request_handler(ACTION_QUERY, self._on_shard_query)
+        transport.register_request_handler(ACTION_FETCH, self._on_shard_fetch)
+        transport.register_request_handler(ACTION_FREE, self._on_free_context)
+
+    # ---------------- shard-level handlers (data node) ----------------
+
+    def _on_shard_query(self, req) -> dict:
+        p = req.payload
+        inst = self.shards.get_shard(p["index"], p["shard_id"])
+        searcher = inst.engine.acquire_searcher()
+        qr: QuerySearchResult = execute_query_phase(
+            searcher, inst.mapper, p["body"])
+        ctx = self.contexts.create(searcher, inst.mapper, p["index"],
+                                   p["shard_id"])
+        hits_wire = [{"leaf_idx": h.leaf_idx, "ord": h.ord,
+                      "score": _py(h.score), "global_ord": h.global_ord,
+                      "sort_values": [_py(v) for v in h.sort_values]
+                      if h.sort_values is not None else None}
+                     for h in qr.hits]
+        aggs_b64 = None
+        if qr.aggregations is not None:
+            aggs_b64 = base64.b64encode(
+                pickle.dumps(qr.aggregations)).decode("ascii")
+        return {"total": qr.total, "relation": qr.relation,
+                "max_score": _py(qr.max_score), "hits": hits_wire,
+                "context_id": ctx.context_id, "aggs": aggs_b64}
+
+    def _on_shard_fetch(self, req) -> dict:
+        p = req.payload
+        ctx = self.contexts.get(p["context_id"])
+        hits = [ShardHit(leaf_idx=h["leaf_idx"], ord=h["ord"],
+                         score=h["score"], global_ord=h["global_ord"],
+                         sort_values=h.get("sort_values"))
+                for h in p["hits"]]
+        fetched = execute_fetch_phase(ctx.searcher, hits, p["body"],
+                                      ctx.index)
+        return {"hits": fetched}
+
+    def _on_free_context(self, req) -> dict:
+        freed = self.contexts.release(req.payload["context_id"])
+        return {"freed": freed}
+
+    # ---------------- coordinator (any node) ----------------
+
+    def execute_search(self, index_expr: str, body: dict,
+                       state: Optional[ClusterState] = None) -> dict:
+        """query_then_fetch across every target shard's best copy."""
+        start = time.monotonic()
+        state = state or self.shards.state
+        indices = state.resolve_indices(index_expr)
+        if not indices:
+            raise IndexNotFoundError(index_expr)
+
+        targets: List[Tuple[str, str, int]] = []   # (node, index, shard_id)
+        for index in indices:
+            meta = state.indices[index]
+            for sid in range(meta.number_of_shards):
+                copies = [r for r in state.shard_copies(index, sid)
+                          if r.state == "STARTED" and r.node_id is not None]
+                if not copies:
+                    raise ElasticsearchTpuError(
+                        f"all shards failed: no started copy of "
+                        f"[{index}][{sid}]")
+                # prefer the local copy (zero hops), else any started one —
+                # adaptive replica selection refines this choice (ref:
+                # OperationRouting.java:34)
+                chosen = next((r for r in copies
+                               if r.node_id == self.shards.node_name),
+                              copies[sid % len(copies)])
+                targets.append((chosen.node_id, index, sid))
+
+        size = int(body.get("size", 10))
+        from_ = int(body.get("from", 0))
+        sort = parse_sort(body.get("sort"))
+
+        shard_results: List[dict] = []
+        failed = 0
+        for node, index, sid in targets:
+            try:
+                resp = self.channels.request(
+                    node, ACTION_QUERY,
+                    {"index": index, "shard_id": sid, "body": body})
+                resp["_node"] = node
+                resp["_index"] = index
+                resp["_shard"] = sid
+                shard_results.append(resp)
+            except Exception:  # noqa: BLE001
+                failed += 1
+
+        # ---- reduce (ref: SearchPhaseController.reducedQueryPhase) ----
+        total = sum(r["total"] for r in shard_results)
+        relation = "gte" if any(r["relation"] == "gte"
+                                for r in shard_results) else "eq"
+        merged: List[Tuple[int, dict, dict]] = []  # (shard_idx, hit, result)
+        for si, r in enumerate(shard_results):
+            for h in r["hits"]:
+                merged.append((si, h, r))
+        if sort:
+            merged.sort(key=lambda t: _sort_key(
+                ShardHit(t[1]["leaf_idx"], t[1]["ord"], t[1]["score"],
+                         t[1]["global_ord"], t[1]["sort_values"]), sort)
+                + (t[0],))
+        else:
+            merged.sort(key=lambda t: (-t[1]["score"], t[0],
+                                       t[1]["global_ord"]))
+        window = merged[from_: from_ + size]
+
+        max_score = None
+        if not sort:
+            ms = [r["max_score"] for r in shard_results
+                  if r["max_score"] is not None]
+            if ms:
+                max_score = max(ms)
+
+        # ---- fetch winning docs from their owning shards ----
+        by_shard: Dict[int, List[dict]] = {}
+        for si, h, r in window:
+            by_shard.setdefault(si, []).append(h)
+        fetched: Dict[Tuple[int, int], dict] = {}  # (shard_idx, pos) -> hit
+        for si, hits in by_shard.items():
+            r = shard_results[si]
+            resp = self.channels.request(
+                r["_node"], ACTION_FETCH,
+                {"context_id": r["context_id"], "hits": hits, "body": body})
+            for h, out in zip(hits, resp["hits"]):
+                fetched[(si, h["global_ord"], h["leaf_idx"])] = out
+
+        hits_out = []
+        for si, h, r in window:
+            out = fetched.get((si, h["global_ord"], h["leaf_idx"]))
+            if out is None:
+                continue
+            if out.get("_score") is None and h.get("sort_values") is None:
+                out["_score"] = h["score"]
+            hits_out.append(out)
+
+        # ---- aggregations: partial reduce then finalize (ref P6) ----
+        aggs_out = None
+        parts = [pickle.loads(base64.b64decode(r["aggs"]))
+                 for r in shard_results if r.get("aggs")]
+        if parts:
+            from elasticsearch_tpu.search.aggregations import finalize_shard_aggs
+
+            aggs_out = finalize_shard_aggs(body, parts)
+
+        # ---- release contexts ----
+        for r in shard_results:
+            try:
+                self.channels.request(
+                    r["_node"], ACTION_FREE,
+                    {"context_id": r["context_id"]})
+            except Exception:  # noqa: BLE001 — reaper collects leftovers
+                pass
+
+        resp = {
+            "took": int((time.monotonic() - start) * 1000),
+            "timed_out": False,
+            "_shards": {"total": len(targets),
+                        "successful": len(shard_results),
+                        "skipped": 0, "failed": failed},
+            "hits": {"total": {"value": total, "relation": relation},
+                     "max_score": max_score, "hits": hits_out},
+        }
+        if aggs_out is not None:
+            resp["aggregations"] = aggs_out
+        return resp

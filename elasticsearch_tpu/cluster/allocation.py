@@ -1,0 +1,207 @@
+"""Shard allocation: assigning shard copies to data nodes.
+
+Re-designs the reference allocation layer (ref:
+cluster/routing/allocation/AllocationService.java — reroute() applies
+deciders then the balanced allocator;
+allocation/allocator/BalancedShardsAllocator.java;
+allocation/decider/SameShardAllocationDecider.java) as a deterministic
+functional step over the immutable ClusterState:
+
+  * `reroute` assigns UNASSIGNED copies to the least-loaded eligible data
+    node (same-shard exclusion: never two copies of one shard on one node),
+    marking them INITIALIZING with a fresh allocation id;
+  * `disassociate_dead_nodes` removes a departed node's copies — a lost
+    primary is replaced by promoting an in-sync STARTED replica (primary
+    term bump, ref: IndexMetadata.primaryTerm fencing) and a replacement
+    replica goes back to UNASSIGNED;
+  * shard-started / shard-failed transitions mirror the master-side
+    routing state machine (ref: ShardStateAction.java).
+
+Pure functions of state -> state: the master applies them inside its
+single-threaded update queue, publishes, and node-local appliers react.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Dict, List, Optional, Set
+
+from elasticsearch_tpu.cluster.state import ClusterState, ShardRouting
+
+
+def _new_allocation_id() -> str:
+    return uuid.uuid4().hex[:20]
+
+
+def _data_nodes(state: ClusterState) -> List[str]:
+    return sorted(nid for nid, n in state.nodes.items() if "data" in n.roles)
+
+
+def _shard_counts(state: ClusterState) -> Dict[str, int]:
+    counts = {nid: 0 for nid in _data_nodes(state)}
+    for shards in state.routing.values():
+        for r in shards:
+            if r.node_id in counts and r.state in ("INITIALIZING", "STARTED"):
+                counts[r.node_id] += 1
+    return counts
+
+
+class AllocationService:
+    """Master-side routing computations (pure state transitions)."""
+
+    def reroute(self, state: ClusterState) -> ClusterState:
+        """Assign unassigned copies; balanced by shard count per node."""
+        counts = _shard_counts(state)
+        if not counts:
+            return state
+        changed = False
+        new_routing: Dict[str, List[ShardRouting]] = {}
+        for index, shards in state.routing.items():
+            remaining = list(shards)
+            out: List[ShardRouting] = []
+            # node ids already holding a copy, per shard id
+            occupied: Dict[int, Set[str]] = {}
+            for r in remaining:
+                if r.node_id is not None and r.state != "UNASSIGNED":
+                    occupied.setdefault(r.shard_id, set()).add(r.node_id)
+            # primaries first: a replica can only initialize against a
+            # started primary (ref: ReplicaShardAllocator waits for primary)
+            for want_primary in (True, False):
+                for r in list(remaining):
+                    if r.primary != want_primary or r.state != "UNASSIGNED":
+                        continue
+                    if not r.primary:
+                        primary = next(
+                            (p for p in remaining + out
+                             if p.shard_id == r.shard_id and p.primary), None)
+                        if primary is None or primary.state != "STARTED":
+                            continue
+                    taken = occupied.get(r.shard_id, set())
+                    candidates = [n for n in counts if n not in taken]
+                    if not candidates:
+                        continue
+                    target = min(candidates, key=lambda n: (counts[n], n))
+                    counts[target] += 1
+                    occupied.setdefault(r.shard_id, set()).add(target)
+                    remaining.remove(r)
+                    out.append(ShardRouting(
+                        index=index, shard_id=r.shard_id, node_id=target,
+                        primary=r.primary, state="INITIALIZING",
+                        allocation_id=_new_allocation_id()))
+                    changed = True
+            out.extend(remaining)
+            out.sort(key=lambda r: (r.shard_id, not r.primary, r.allocation_id))
+            new_routing[index] = out
+        if not changed:
+            return state
+        st = state
+        for index, entries in new_routing.items():
+            st = st.with_routing_updates(index, entries)
+        return st
+
+    def apply_started_shard(self, state: ClusterState, index: str,
+                            shard_id: int, allocation_id: str) -> ClusterState:
+        """INITIALIZING -> STARTED; add to the in-sync set (ref:
+        ShardStateAction.ShardStartedClusterStateTaskExecutor +
+        IndexMetadataUpdater.applyChanges adds the allocation id)."""
+        shards = list(state.routing.get(index, []))
+        changed = False
+        for i, r in enumerate(shards):
+            if (r.shard_id == shard_id and r.allocation_id == allocation_id
+                    and r.state == "INITIALIZING"):
+                shards[i] = ShardRouting(
+                    index=index, shard_id=shard_id, node_id=r.node_id,
+                    primary=r.primary, state="STARTED",
+                    allocation_id=allocation_id)
+                changed = True
+        if not changed:
+            return state
+        st = state.with_routing_updates(index, shards)
+        meta = st.indices[index]
+        in_sync = set(meta.in_sync_allocations.get(shard_id, ()))
+        in_sync.add(allocation_id)
+        return st.with_index_metadata(
+            meta.with_in_sync(shard_id, tuple(sorted(in_sync))))
+
+    def apply_failed_shard(self, state: ClusterState, index: str,
+                           shard_id: int, allocation_id: str) -> ClusterState:
+        """Remove a failed copy from routing and the in-sync set, then leave
+        an UNASSIGNED replacement (ref: ShardStateAction shard-failed)."""
+        shards = list(state.routing.get(index, []))
+        failed = next((r for r in shards
+                       if r.shard_id == shard_id
+                       and r.allocation_id == allocation_id), None)
+        if failed is None:
+            return state
+        shards.remove(failed)
+        st = state
+        if failed.primary:
+            shards, st = _promote_replacement(st, index, shard_id, shards)
+        shards.append(ShardRouting(index=index, shard_id=shard_id,
+                                   node_id=None, primary=False,
+                                   state="UNASSIGNED"))
+        st = st.with_routing_updates(index, shards)
+        meta = st.indices[index]
+        in_sync = set(meta.in_sync_allocations.get(shard_id, ()))
+        in_sync.discard(allocation_id)
+        st = st.with_index_metadata(
+            meta.with_in_sync(shard_id, tuple(sorted(in_sync))))
+        return self.reroute(st)
+
+    def disassociate_dead_nodes(self, state: ClusterState,
+                                dead: Set[str]) -> ClusterState:
+        """Node-left: drop the node, promote replicas for its primaries,
+        queue replacements (ref: NodeRemovalClusterStateTaskExecutor ->
+        AllocationService.disassociateDeadNodes)."""
+        st = state
+        for nid in dead:
+            st = st.without_node(nid)
+        for index in list(st.routing):
+            shards = list(st.routing[index])
+            lost = [r for r in shards if r.node_id in dead]
+            if not lost:
+                continue
+            for r in lost:
+                shards.remove(r)
+            for r in lost:
+                if r.primary:
+                    shards, st = _promote_replacement(st, index, r.shard_id,
+                                                      shards)
+                shards.append(ShardRouting(index=index, shard_id=r.shard_id,
+                                           node_id=None, primary=False,
+                                           state="UNASSIGNED"))
+            meta = st.indices[index]
+            for r in lost:
+                in_sync = set(meta.in_sync_allocations.get(r.shard_id, ()))
+                # the departed copy leaves the in-sync set only if a live
+                # copy remains to serve as primary; otherwise keeping it
+                # records which copy a future allocate-stale must find
+                survivors = [s for s in shards
+                             if s.shard_id == r.shard_id
+                             and s.state == "STARTED"]
+                if survivors:
+                    in_sync.discard(r.allocation_id)
+                    meta = meta.with_in_sync(r.shard_id, tuple(sorted(in_sync)))
+            st = st.with_index_metadata(meta)
+            st = st.with_routing_updates(index, shards)
+        return self.reroute(st)
+
+
+def _promote_replacement(state: ClusterState, index: str, shard_id: int,
+                         shards: List[ShardRouting]):
+    """Pick the in-sync STARTED replica to promote to primary; bump the
+    shard's primary term (ref: RoutingNodes.promoteActiveReplicaShardToPrimary
+    + IndexMetadataUpdater primary-term increment)."""
+    meta = state.indices[index]
+    in_sync = set(meta.in_sync_allocations.get(shard_id, ()))
+    candidates = [r for r in shards
+                  if r.shard_id == shard_id and not r.primary
+                  and r.state == "STARTED" and r.allocation_id in in_sync]
+    if not candidates:
+        return shards, state     # red shard: no safe copy to promote
+    chosen = sorted(candidates, key=lambda r: r.allocation_id)[0]
+    shards[shards.index(chosen)] = ShardRouting(
+        index=index, shard_id=shard_id, node_id=chosen.node_id,
+        primary=True, state="STARTED", allocation_id=chosen.allocation_id)
+    state = state.with_index_metadata(meta.with_primary_term_bump(shard_id))
+    return shards, state

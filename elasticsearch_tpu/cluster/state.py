@@ -24,6 +24,16 @@ class DiscoveryNode:
     address: str = "127.0.0.1:9300"
     roles: tuple = ("master", "data", "ingest")
 
+    def to_dict(self) -> dict:
+        return {"node_id": self.node_id, "name": self.name,
+                "address": self.address, "roles": list(self.roles)}
+
+    @staticmethod
+    def from_dict(d: dict) -> "DiscoveryNode":
+        return DiscoveryNode(node_id=d["node_id"], name=d["name"],
+                             address=d.get("address", ""),
+                             roles=tuple(d.get("roles", ())))
+
 
 @dataclass(frozen=True)
 class ShardRouting:
@@ -36,6 +46,18 @@ class ShardRouting:
     state: str = "STARTED"     # UNASSIGNED | INITIALIZING | STARTED | RELOCATING
     allocation_id: str = ""
 
+    def to_dict(self) -> dict:
+        return {"index": self.index, "shard_id": self.shard_id,
+                "node_id": self.node_id, "primary": self.primary,
+                "state": self.state, "allocation_id": self.allocation_id}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ShardRouting":
+        return ShardRouting(index=d["index"], shard_id=d["shard_id"],
+                            node_id=d.get("node_id"), primary=d["primary"],
+                            state=d.get("state", "STARTED"),
+                            allocation_id=d.get("allocation_id", ""))
+
 
 @dataclass(frozen=True)
 class IndexMetadata:
@@ -47,6 +69,12 @@ class IndexMetadata:
     state: str = "open"
     creation_date: int = field(default_factory=lambda: int(time.time() * 1000))
     version: int = 1
+    # per-shard primary terms, bumped on every primary failover (ref:
+    # IndexMetadata.primaryTerm — the fencing token replicas check)
+    primary_terms: tuple = ()
+    # per-shard in-sync allocation ids (ref: IndexMetadata
+    # in_sync_allocations — the copies a promoted primary may come from)
+    in_sync_allocations: Dict[int, tuple] = field(default_factory=dict)
 
     @property
     def number_of_shards(self) -> int:
@@ -55,6 +83,44 @@ class IndexMetadata:
     @property
     def number_of_replicas(self) -> int:
         return int(self.settings.raw("index.number_of_replicas", 1))
+
+    def primary_term(self, shard_id: int) -> int:
+        if shard_id < len(self.primary_terms):
+            return self.primary_terms[shard_id]
+        return 1
+
+    def with_primary_term_bump(self, shard_id: int) -> "IndexMetadata":
+        terms = list(self.primary_terms) or [1] * self.number_of_shards
+        while len(terms) <= shard_id:
+            terms.append(1)
+        terms[shard_id] += 1
+        return replace(self, version=self.version + 1, primary_terms=tuple(terms))
+
+    def with_in_sync(self, shard_id: int, allocation_ids: tuple) -> "IndexMetadata":
+        in_sync = dict(self.in_sync_allocations)
+        in_sync[shard_id] = tuple(allocation_ids)
+        return replace(self, version=self.version + 1, in_sync_allocations=in_sync)
+
+    def to_dict(self) -> dict:
+        return {"index": self.index, "uuid": self.uuid,
+                "settings": self.settings.as_dict(), "mappings": self.mappings,
+                "aliases": self.aliases, "state": self.state,
+                "creation_date": self.creation_date, "version": self.version,
+                "primary_terms": list(self.primary_terms),
+                "in_sync_allocations": {str(k): list(v) for k, v in
+                                        self.in_sync_allocations.items()}}
+
+    @staticmethod
+    def from_dict(d: dict) -> "IndexMetadata":
+        return IndexMetadata(
+            index=d["index"], uuid=d["uuid"], settings=Settings(d["settings"]),
+            mappings=d.get("mappings", {}), aliases=d.get("aliases", {}),
+            state=d.get("state", "open"),
+            creation_date=d.get("creation_date", 0),
+            version=d.get("version", 1),
+            primary_terms=tuple(d.get("primary_terms", ())),
+            in_sync_allocations={int(k): tuple(v) for k, v in
+                                 d.get("in_sync_allocations", {}).items()})
 
 
 @dataclass(frozen=True)
@@ -87,6 +153,72 @@ class ClusterState:
         nodes = dict(self.nodes)
         nodes[node.node_id] = node
         return replace(self, version=self.version + 1, nodes=nodes)
+
+    def without_node(self, node_id: str) -> "ClusterState":
+        nodes = dict(self.nodes)
+        nodes.pop(node_id, None)
+        master = self.master_node_id if self.master_node_id != node_id else None
+        return replace(self, version=self.version + 1, nodes=nodes,
+                       master_node_id=master)
+
+    def with_routing_updates(self, index: str,
+                             entries: List[ShardRouting]) -> "ClusterState":
+        rt = dict(self.routing)
+        rt[index] = entries
+        return replace(self, version=self.version + 1, routing=rt)
+
+    def with_index_metadata(self, meta: IndexMetadata) -> "ClusterState":
+        indices = dict(self.indices)
+        indices[meta.index] = meta
+        return replace(self, version=self.version + 1, indices=indices)
+
+    def shard_copies(self, index: str, shard_id: int) -> List[ShardRouting]:
+        return [r for r in self.routing.get(index, []) if r.shard_id == shard_id]
+
+    def primary_of(self, index: str, shard_id: int) -> Optional[ShardRouting]:
+        for r in self.routing.get(index, []):
+            if r.shard_id == shard_id and r.primary:
+                return r
+        return None
+
+    def entries_on_node(self, node_id: str) -> List[ShardRouting]:
+        return [r for shards in self.routing.values() for r in shards
+                if r.node_id == node_id]
+
+    def node_by_name(self, name: str) -> Optional[DiscoveryNode]:
+        for n in self.nodes.values():
+            if n.name == name:
+                return n
+        return None
+
+    # ---- wire form (the consensus-replicated value) ----
+
+    def to_dict(self) -> dict:
+        return {
+            "cluster_name": self.cluster_name,
+            "version": self.version,
+            "term": self.term,
+            "master_node_id": self.master_node_id,
+            "nodes": {nid: n.to_dict() for nid, n in self.nodes.items()},
+            "indices": {name: m.to_dict() for name, m in self.indices.items()},
+            "routing": {name: [r.to_dict() for r in shards]
+                        for name, shards in self.routing.items()},
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "ClusterState":
+        return ClusterState(
+            cluster_name=d.get("cluster_name", "elasticsearch-tpu"),
+            version=d.get("version", 0),
+            term=d.get("term", 0),
+            master_node_id=d.get("master_node_id"),
+            nodes={nid: DiscoveryNode.from_dict(n)
+                   for nid, n in d.get("nodes", {}).items()},
+            indices={name: IndexMetadata.from_dict(m)
+                     for name, m in d.get("indices", {}).items()},
+            routing={name: [ShardRouting.from_dict(r) for r in shards]
+                     for name, shards in d.get("routing", {}).items()},
+        )
 
     def resolve_indices(self, expression: str) -> List[str]:
         """Index-name expression resolution: names, aliases, wildcards, _all

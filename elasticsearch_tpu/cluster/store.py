@@ -1,0 +1,109 @@
+"""Cluster-state stores: how committed states reach every node.
+
+Two implementations of one seam (the reference equivalent is
+MasterService.submitStateUpdateTask -> Coordinator.publish ->
+ClusterApplierService on every node):
+
+  * LocalStateStore — one shared store for in-process multi-node tests:
+    synchronous, deterministic apply order, reentrancy-safe via an update
+    queue (a state application may itself submit follow-up updates — e.g.
+    shard-started reports — which drain in order, ref:
+    MasterService.runTasks single-threaded batching).
+  * ConsensusStateStore — wraps a live ClusterFormationService: the value
+    replicated by the coordination layer IS ClusterState.to_dict(); commits
+    arrive via the coordinator's on_commit callback.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+
+class NotMasterError(ElasticsearchTpuError):
+    status = 503
+    error_type = "not_master_exception"
+
+
+class LocalStateStore:
+    """Shared store for in-process clusters (deterministic tests)."""
+
+    def __init__(self, initial: ClusterState, master_name: str):
+        self.state = initial
+        self.master_name = master_name
+        self._appliers: Dict[str, Callable[[ClusterState], None]] = {}
+        self._lock = threading.RLock()
+        self._queue: List[Callable[[ClusterState], ClusterState]] = []
+        self._draining = False
+
+    def add_applier(self, name: str,
+                    fn: Callable[[ClusterState], None]) -> None:
+        self._appliers[name] = fn
+
+    def remove_applier(self, name: str) -> None:
+        self._appliers.pop(name, None)
+
+    def master_node(self) -> Optional[str]:
+        return self.master_name
+
+    def is_master(self, name: str) -> bool:
+        return name == self.master_name
+
+    def current(self) -> ClusterState:
+        return self.state
+
+    def submit(self, updater: Callable[[ClusterState], ClusterState]
+               ) -> ClusterState:
+        """Run updater through the single-threaded master queue; apply each
+        resulting state on every node applier in name order. Nested submits
+        (from appliers' deferred actions) enqueue and drain in order."""
+        with self._lock:
+            self._queue.append(updater)
+            if self._draining:
+                return self.state
+            self._draining = True
+            try:
+                while self._queue:
+                    up = self._queue.pop(0)
+                    new_state = up(self.state)
+                    if new_state is self.state:
+                        continue
+                    self.state = new_state
+                    for name in sorted(self._appliers):
+                        self._appliers[name](new_state)
+            finally:
+                self._draining = False
+            return self.state
+
+
+class ConsensusStateStore:
+    """Per-node store over the live coordination layer."""
+
+    def __init__(self, formation) -> None:
+        # formation: cluster/cluster_service.ClusterFormationService whose
+        # replicated value is ClusterState.to_dict()
+        self.formation = formation
+
+    def master_node(self) -> Optional[str]:
+        if self.formation.is_leader:
+            return self.formation.node_name
+        return self.formation.leader_name
+
+    def is_master(self, name: str) -> bool:
+        return self.master_node() == name
+
+    def current(self) -> ClusterState:
+        return ClusterState.from_dict(self.formation.committed_value())
+
+    def submit(self, updater: Callable[[ClusterState], ClusterState]
+               ) -> ClusterState:
+        if not self.formation.is_leader:
+            raise NotMasterError(
+                f"not the elected master (leader: "
+                f"{self.formation.leader_name})")
+        value = self.formation.submit_state_update(
+            lambda v: updater(ClusterState.from_dict(v)).to_dict())
+        return ClusterState.from_dict(value)

@@ -493,6 +493,67 @@ class InternalEngine:
             else:
                 self.delete(op["id"], seq_no=op["seq_no"], from_translog=True)
 
+    # ---------------- peer-recovery snapshot transfer ----------------
+
+    def segment_payloads(self) -> tuple:
+        """File-phase recovery source: freeze the buffer, then hand out each
+        published segment with its live mask (ref:
+        indices/recovery/RecoverySourceHandler.java:267 phase1 — segment
+        files are the recovery snapshot; here the segment IS the file).
+        Returns ([(pickled segment bytes, live mask)], max_seq_no)."""
+        with self._lock:
+            self.refresh()
+            # segments are immutable once published: snapshot the references
+            # and mask copies under the lock, serialize OUTSIDE it so a
+            # large phase1 transfer does not stall indexing on the source
+            snapshot = [(seg, self._live[i].copy())
+                        for i, seg in enumerate(self._segments)]
+            max_seq_no = self._seqno.max_seq_no
+        payloads = [
+            (pickle.dumps(seg, protocol=pickle.HIGHEST_PROTOCOL), live)
+            for seg, live in snapshot
+        ]
+        return payloads, max_seq_no
+
+    def install_segment(self, blob: bytes, live_mask) -> None:
+        """File-phase recovery target: install one transferred segment
+        (ref: indices/recovery/MultiFileWriter.java writes phase1 files).
+        Ops-phase replay above the snapshot's seqnos follows separately."""
+        with self._lock:
+            seg: Segment = pickle.loads(blob)
+            seg_idx = len(self._segments)
+            live = np.asarray(live_mask, bool)
+            # remap to a locally-assigned seg id: the source's id can collide
+            # with a locally-refreshed segment's id, and flush()'s
+            # dedup-by-filename would then commit one payload under both
+            seg.seg_id = self._next_seg_id
+            self._segments.append(seg)
+            self._live.append(live.copy())
+            self._live_epochs.append(0)
+            self._next_seg_id += 1
+            for ord_, doc_id in enumerate(seg.doc_ids):
+                if not live[ord_]:
+                    continue
+                seq = int(seg.seq_nos[ord_])
+                prev = self._versions.get(doc_id)
+                if prev is not None and prev.seq_no >= seq:
+                    # a live write that raced ahead of the transfer wins;
+                    # hide the stale installed copy
+                    self._live[seg_idx][ord_] = False
+                    self._live_epochs[seg_idx] += 1
+                    continue
+                if prev is not None and not prev.deleted:
+                    if prev.in_buffer:
+                        self._buffer.pop(doc_id, None)
+                        if doc_id in self._buffer_order:
+                            self._buffer_order.remove(doc_id)
+                    elif prev.seg_idx >= 0:
+                        self._tombstone(prev.seg_idx, prev.ord)
+                self._versions[doc_id] = _VersionEntry(
+                    seq_no=seq, version=int(seg.versions[ord_]),
+                    deleted=False, in_buffer=False, seg_idx=seg_idx, ord=ord_)
+                self._seqno.mark_processed(seq)
+
     def force_merge(self, max_num_segments: int = 1) -> None:
         """Compact segments by rebuilding live docs (host recompaction)."""
         with self._lock:

@@ -183,35 +183,19 @@ class ReplicationGroup:
             survivors = dict(self.replicas)
         for aid, copy in survivors.items():
             try:
+                # fence FIRST: a late write from the deposed primary accepted
+                # after docs_above would otherwise escape the rollback set
                 copy.engine.advance_primary_term(new_term)
-                # roll back divergent ops the old primary replicated beyond
-                # the global checkpoint but the new primary never saw
-                for doc_id in copy.engine.docs_above(gcp):
-                    copy.engine.force_resync_doc(
-                        doc_id, new_primary.engine.doc_resync_state(doc_id))
+                divergent = copy.engine.docs_above(gcp)
+                doc_states = {d: new_primary.engine.doc_resync_state(d)
+                              for d in divergent}
                 # a copy still catching up (tracked, not yet in-sync) may be
                 # behind the global checkpoint — replay from wherever it is
                 replay_from = min(gcp, copy.engine.local_checkpoint)
-                copy.engine.reset_local_checkpoint(replay_from)
-                for op in new_primary.engine.changes_since(replay_from):
-                    self._apply_to_copy(copy, {"op": op["op"], "id": op["id"],
-                                               "source": op.get("source"),
-                                               "seq_no": op["seq_no"],
-                                               "primary_term": new_term})
-                copy.engine.fill_seqno_gaps(new_primary.engine.max_seq_no)
-                # the trim dropped durable records above replay_from and the
-                # replay may have no-opped against identical entries — re-log
-                # so crash recovery still covers the resynced tail
-                copy.engine.relog_above(replay_from)
-                # a divergent op already FLUSHED into a committed segment is
-                # only tombstoned in memory by the rollback above; the
-                # on-disk commit's live mask would resurrect it on crash
-                # recovery (its seqno can sit below the committed checkpoint,
-                # out of translog-replay range). Re-commit so the durable
-                # state matches the rolled-back state before promote returns
-                # (ref: the reference resets replicas to a safe commit whose
-                # max_seq_no <= global checkpoint, then re-commits)
-                copy.engine.flush()
+                resync_target_apply(
+                    copy.engine, new_term, doc_states, replay_from,
+                    new_primary.engine.changes_since(replay_from),
+                    new_primary.engine.max_seq_no)
             except Exception as e:  # noqa: BLE001
                 group.on_replica_failure(aid, e)
                 continue
@@ -231,6 +215,43 @@ class ReplicationGroup:
     def copies(self) -> List[ShardCopy]:
         with self._lock:
             return [self.primary, *self.replicas.values()]
+
+
+def resync_target_apply(engine: InternalEngine, new_term: int,
+                        doc_states: Dict[str, Optional[dict]],
+                        replay_from: int, ops: List[dict],
+                        max_seq_no: int) -> None:
+    """Target-side primary-replica resync: adopt the new term, roll back
+    divergent docs to the new primary's authoritative per-doc state, replay
+    its history above the rollback point, and make the result durable.
+
+    Shared by the in-process ReplicationGroup.promote and the transport
+    resync action (ref: index/shard/PrimaryReplicaSyncer.java + replica
+    engine reset to the global checkpoint).
+
+      * advance term first so even a zero-op resync fences the deposed
+        primary;
+      * rollback before replay so force_resync_doc's per-doc tombstones
+        cannot clobber replayed newer ops;
+      * relog + flush so a crash after resync recovers the resynced state,
+        not the divergent one (divergent ops already flushed into committed
+        segments sit below the committed checkpoint, out of translog-replay
+        range — only a re-commit removes them durably).
+    """
+    engine.advance_primary_term(new_term)
+    for doc_id, state in doc_states.items():
+        engine.force_resync_doc(doc_id, state)
+    engine.reset_local_checkpoint(replay_from)
+    for op in ops:
+        if op["op"] == "index":
+            engine.index(op["id"], op.get("source"), seq_no=op["seq_no"],
+                         op_primary_term=new_term)
+        else:
+            engine.delete(op["id"], seq_no=op["seq_no"],
+                          op_primary_term=new_term)
+    engine.fill_seqno_gaps(max_seq_no)
+    engine.relog_above(replay_from)
+    engine.flush()
 
 
 def new_allocation_id() -> str:

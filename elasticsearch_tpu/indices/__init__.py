@@ -1,0 +1,13 @@
+"""Node-level distributed shard management (ref: server/.../indices/)."""
+
+from elasticsearch_tpu.indices.shard_service import (
+    DistributedShardService, ShardInstance, ShardNotFoundError,
+)
+from elasticsearch_tpu.indices.cluster_state_service import (
+    IndicesClusterStateService,
+)
+
+__all__ = [
+    "DistributedShardService", "ShardInstance", "ShardNotFoundError",
+    "IndicesClusterStateService",
+]

@@ -1,0 +1,445 @@
+"""Distributed shard instances: replicated writes, peer recovery, resync —
+all over the transport.
+
+This is the node-local half of the distributed spine. The reference spreads
+it across IndexShard (op application, ref: index/shard/IndexShard.java:798
+applyIndexOperationOnPrimary / :807 OnReplica), the replication template
+(ref: action/support/replication/ReplicationOperation.java:99 — primary
+executes, fans to in-sync replicas, collects acks, fails stale copies via
+the master), peer recovery (ref:
+indices/recovery/RecoverySourceHandler.java:139 recoverToTarget — file
+phase1 + ops phase2 + finalize; PeerRecoveryTargetService.java), and the
+primary-replica syncer (ref: index/shard/PrimaryReplicaSyncer.java). Here
+one service owns the shard registry and registers every shard-level
+transport action; the cluster-state applier (cluster_state_service.py)
+drives lifecycle.
+
+Recovery is TARGET-DRIVEN (pull): the new replica asks the primary to
+track it, pulls the segment snapshot (the segment IS the recovery file),
+replays the op tail, then finalizes. Pull keeps every step idempotent, so
+an interrupted recovery simply restarts.
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuError, VersionConflictError,
+)
+from elasticsearch_tpu.cluster.state import ClusterState, IndexMetadata, ShardRouting
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.index.replication import resync_target_apply
+from elasticsearch_tpu.index.seqno import NO_OPS_PERFORMED, ReplicationTracker
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.transport.channels import NodeChannels, NodeUnavailableError
+from elasticsearch_tpu.transport.service import TransportService
+
+
+class ShardNotFoundError(ElasticsearchTpuError):
+    status = 404
+    error_type = "shard_not_found_exception"
+
+
+class PrimaryTermMismatchError(ElasticsearchTpuError):
+    status = 409
+    error_type = "illegal_index_shard_state_exception"
+
+
+@dataclass
+class ShardInstance:
+    """One local shard copy (ref: index/shard/IndexShard.java state)."""
+
+    index: str
+    shard_id: int
+    allocation_id: str
+    primary: bool
+    primary_term: int
+    engine: InternalEngine
+    mapper: MapperService
+    tracker: Optional[ReplicationTracker] = None      # primary only
+    # replica-side view of the primary's global checkpoint, refreshed on
+    # every replicated write (ref: GlobalCheckpointSyncAction) — the
+    # rollback point if this copy is promoted
+    known_global_checkpoint: int = NO_OPS_PERFORMED
+    state: str = "INITIALIZING"                       # mirrors routing state
+    lock: threading.RLock = field(default_factory=threading.RLock)
+
+
+def build_mapper(meta: IndexMetadata) -> MapperService:
+    nested = meta.settings.as_nested_dict()
+    try:
+        analyzers = nested["index"]["analysis"]["analyzer"]
+    except (KeyError, TypeError):
+        analyzers = {}
+    return MapperService(dict(meta.mappings), AnalysisRegistry(analyzers))
+
+
+class DistributedShardService:
+    """Registry of local shard copies + shard-level transport actions."""
+
+    def __init__(self, node_name: str, transport: TransportService,
+                 channels: NodeChannels,
+                 master_client: Callable[[str, dict], dict],
+                 data_path: Optional[str] = None):
+        self.node_name = node_name
+        self.transport = transport
+        self.channels = channels
+        self.master_client = master_client
+        self.data_path = data_path
+        self.shards: Dict[Tuple[str, int], ShardInstance] = {}
+        self.state: ClusterState = ClusterState()
+        self._registry_lock = threading.Lock()
+        t = transport
+        t.register_request_handler("indices:data/write/bulk[s]",
+                                   self._on_primary_bulk)
+        t.register_request_handler("indices:data/write/bulk[s][r]",
+                                   self._on_replica_bulk)
+        t.register_request_handler("internal:index/shard/recovery/prepare",
+                                   self._on_recovery_prepare)
+        t.register_request_handler("internal:index/shard/recovery/segments",
+                                   self._on_recovery_segments)
+        t.register_request_handler("internal:index/shard/recovery/ops",
+                                   self._on_recovery_ops)
+        t.register_request_handler("internal:index/shard/recovery/finalize",
+                                   self._on_recovery_finalize)
+        t.register_request_handler("internal:index/shard/resync/prepare",
+                                   self._on_resync_prepare)
+        t.register_request_handler("internal:index/shard/resync/apply",
+                                   self._on_resync_apply)
+
+    # ---------------- registry ----------------
+
+    def get_shard(self, index: str, shard_id: int) -> ShardInstance:
+        inst = self.shards.get((index, shard_id))
+        if inst is None:
+            raise ShardNotFoundError(
+                f"no shard [{index}][{shard_id}] on node [{self.node_name}]")
+        return inst
+
+    def create_shard(self, meta: IndexMetadata,
+                     routing: ShardRouting) -> ShardInstance:
+        import os
+
+        mapper = build_mapper(meta)
+        path = None
+        if self.data_path is not None:
+            path = os.path.join(self.data_path, meta.index,
+                                str(routing.shard_id))
+        durability = meta.settings.raw("index.translog.durability", "request")
+        engine = InternalEngine(mapper, data_path=path,
+                                primary_term=meta.primary_term(routing.shard_id),
+                                translog_durability=durability)
+        inst = ShardInstance(
+            index=meta.index, shard_id=routing.shard_id,
+            allocation_id=routing.allocation_id, primary=routing.primary,
+            primary_term=meta.primary_term(routing.shard_id),
+            engine=engine, mapper=mapper)
+        if routing.primary:
+            inst.tracker = ReplicationTracker(routing.allocation_id)
+            inst.tracker.update_local_checkpoint(
+                routing.allocation_id, engine.local_checkpoint)
+        with self._registry_lock:
+            self.shards[(meta.index, routing.shard_id)] = inst
+        return inst
+
+    def remove_shard(self, index: str, shard_id: int) -> None:
+        with self._registry_lock:
+            inst = self.shards.pop((index, shard_id), None)
+        if inst is not None:
+            inst.engine.close()
+
+    # ---------------- write path (primary side) ----------------
+
+    def _on_primary_bulk(self, req) -> dict:
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        if not inst.primary:
+            raise ShardNotFoundError(
+                f"shard [{p['index']}][{p['shard_id']}] on "
+                f"[{self.node_name}] is not the primary")
+        req_term = p.get("primary_term")
+        if req_term is not None and req_term < inst.primary_term:
+            # the coordinator routed with a stale cluster state; make it retry
+            raise PrimaryTermMismatchError(
+                f"request term [{req_term}] below current "
+                f"[{inst.primary_term}]")
+        with inst.lock:
+            results: List[dict] = []
+            rep_ops: List[dict] = []
+            for op in p["ops"]:
+                try:
+                    if op["op"] in ("index", "create"):
+                        r = inst.engine.index(
+                            op["id"], op["source"], op_type=op["op"],
+                            if_seq_no=op.get("if_seq_no"),
+                            if_primary_term=op.get("if_primary_term"))
+                        status = 201 if r.result == "created" else 200
+                    else:
+                        r = inst.engine.delete(
+                            op["id"],
+                            if_seq_no=op.get("if_seq_no"),
+                            if_primary_term=op.get("if_primary_term"))
+                        status = 404 if r.result == "not_found" else 200
+                    results.append({"_id": r.doc_id, "_version": r.version,
+                                    "_seq_no": r.seq_no,
+                                    "_primary_term": r.primary_term,
+                                    "result": r.result, "status": status})
+                    if r.result != "not_found":
+                        rep_ops.append({
+                            "op": "delete" if op["op"] == "delete" else "index",
+                            "id": op["id"], "source": op.get("source"),
+                            "seq_no": r.seq_no})
+                except VersionConflictError as e:
+                    results.append({"_id": op["id"], "status": 409,
+                                    "error": e.to_dict()})
+            self._replicate(inst, rep_ops)
+            inst.tracker.update_local_checkpoint(
+                inst.allocation_id, inst.engine.local_checkpoint)
+            return {"results": results,
+                    "local_checkpoint": inst.engine.local_checkpoint,
+                    "global_checkpoint": inst.tracker.global_checkpoint}
+
+    def _replicate(self, inst: ShardInstance, rep_ops: List[dict]) -> None:
+        """Fan one op batch to every assigned copy (ref:
+        ReplicationOperation.java:137 performOnReplicas). In-sync copy
+        failure -> shard-failed to master; a still-recovering copy may miss
+        writes (recovery's finalize gap replay covers it)."""
+        if not rep_ops:
+            return
+        state = self.state
+        gcp = inst.tracker.global_checkpoint
+        for r in state.shard_copies(inst.index, inst.shard_id):
+            if r.primary or r.node_id is None or r.state == "UNASSIGNED":
+                continue
+            if r.allocation_id == inst.allocation_id:
+                continue
+            in_sync = r.allocation_id in inst.tracker.in_sync_ids
+            try:
+                resp = self.channels.request(
+                    r.node_id, "indices:data/write/bulk[s][r]",
+                    {"index": inst.index, "shard_id": inst.shard_id,
+                     "primary_term": inst.primary_term, "ops": rep_ops,
+                     "global_checkpoint": gcp})
+                inst.tracker.update_local_checkpoint(
+                    r.allocation_id, resp["local_checkpoint"])
+            except Exception as e:  # noqa: BLE001 — any failure fails the copy
+                if in_sync:
+                    inst.tracker.remove_tracking(r.allocation_id)
+                    self._report_shard_failed(inst.index, inst.shard_id,
+                                              r.allocation_id, str(e))
+
+    def _report_shard_failed(self, index: str, shard_id: int,
+                             allocation_id: str, reason: str) -> None:
+        try:
+            self.master_client("internal:cluster/shard/failed",
+                               {"index": index, "shard_id": shard_id,
+                                "allocation_id": allocation_id,
+                                "reason": reason})
+        except Exception:  # noqa: BLE001 — master unreachable; next state
+            pass           # application reconciles
+
+    # ---------------- write path (replica side) ----------------
+
+    def _on_replica_bulk(self, req) -> dict:
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        term = p["primary_term"]
+        if term < inst.primary_term:
+            raise PrimaryTermMismatchError(
+                f"replication from deposed primary (term [{term}] < "
+                f"[{inst.primary_term}])")
+        with inst.lock:
+            inst.primary_term = max(inst.primary_term, term)
+            for op in p["ops"]:
+                if op["op"] == "index":
+                    inst.engine.index(op["id"], op["source"],
+                                      seq_no=op["seq_no"],
+                                      op_primary_term=term)
+                else:
+                    inst.engine.delete(op["id"], seq_no=op["seq_no"],
+                                       op_primary_term=term)
+            inst.known_global_checkpoint = max(
+                inst.known_global_checkpoint,
+                p.get("global_checkpoint", NO_OPS_PERFORMED))
+            return {"local_checkpoint": inst.engine.local_checkpoint}
+
+    # ---------------- peer recovery: source handlers ----------------
+
+    def _on_recovery_prepare(self, req) -> dict:
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        if not inst.primary:
+            raise ShardNotFoundError("recovery source must be the primary")
+        with inst.lock:
+            # phase0: track the target so concurrent writes reach it from
+            # now on (ref: RecoverySourceHandler add to replication group)
+            inst.tracker.add_tracking(p["target_allocation_id"])
+            return {"primary_term": inst.primary_term,
+                    "global_checkpoint": inst.tracker.global_checkpoint}
+
+    def _on_recovery_segments(self, req) -> dict:
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        payloads, max_seq_no = inst.engine.segment_payloads()
+        return {"segments": [
+            {"blob": base64.b64encode(blob).decode("ascii"),
+             "live": live.tolist()} for blob, live in payloads],
+            "max_seq_no": max_seq_no}
+
+    def _on_recovery_ops(self, req) -> dict:
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        ops = inst.engine.changes_since(p["above_seq_no"])
+        return {"ops": ops, "max_seq_no": inst.engine.max_seq_no,
+                "primary_term": inst.primary_term}
+
+    def _on_recovery_finalize(self, req) -> dict:
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        with inst.lock:
+            # the lock is the linearization point: any write that failed to
+            # reach the (not-yet-in-sync) target is visible here as a gap
+            # above the target's checkpoint; ship it before marking in-sync
+            gap_ops = inst.engine.changes_since(p["local_checkpoint"])
+            inst.tracker.update_local_checkpoint(
+                p["target_allocation_id"], p["local_checkpoint"])
+            inst.tracker.mark_in_sync(p["target_allocation_id"])
+            return {"gap_ops": gap_ops,
+                    "max_seq_no": inst.engine.max_seq_no,
+                    "primary_term": inst.primary_term,
+                    "global_checkpoint": inst.tracker.global_checkpoint}
+
+    # ---------------- peer recovery: target routine ----------------
+
+    def recover_replica(self, inst: ShardInstance) -> None:
+        """Pull-based replica bootstrap from the primary node (ref:
+        indices/recovery/PeerRecoveryTargetService.java doRecovery).
+        Raises on failure; caller may retry (every step is idempotent)."""
+        state = self.state
+        primary = state.primary_of(inst.index, inst.shard_id)
+        if primary is None or primary.node_id is None \
+                or primary.state != "STARTED":
+            raise ShardNotFoundError(
+                f"no started primary for [{inst.index}][{inst.shard_id}]")
+        source = primary.node_id
+        shard_ref = {"index": inst.index, "shard_id": inst.shard_id}
+        prep = self.channels.request(
+            source, "internal:index/shard/recovery/prepare",
+            {**shard_ref, "target_allocation_id": inst.allocation_id,
+             "target_node": self.node_name})
+        inst.primary_term = max(inst.primary_term, prep["primary_term"])
+        inst.engine.advance_primary_term(prep["primary_term"])
+        # phase1 (file phase): install the segment snapshot when this copy
+        # is empty — segments are the recovery files
+        if inst.engine.max_seq_no == NO_OPS_PERFORMED:
+            seg_resp = self.channels.request(
+                source, "internal:index/shard/recovery/segments", shard_ref)
+            for seg in seg_resp["segments"]:
+                inst.engine.install_segment(
+                    base64.b64decode(seg["blob"]), seg["live"])
+            inst.engine.fill_seqno_gaps(seg_resp["max_seq_no"])
+        # phase2 (ops phase): replay history above what we hold
+        ops_resp = self.channels.request(
+            source, "internal:index/shard/recovery/ops",
+            {**shard_ref, "above_seq_no": inst.engine.local_checkpoint})
+        self._apply_recovery_ops(inst, ops_resp["ops"],
+                                 ops_resp["primary_term"])
+        inst.engine.fill_seqno_gaps(ops_resp["max_seq_no"])
+        # finalize: source marks us in-sync and ships any writes that missed
+        # us while we were not yet required
+        fin = self.channels.request(
+            source, "internal:index/shard/recovery/finalize",
+            {**shard_ref, "target_allocation_id": inst.allocation_id,
+             "local_checkpoint": inst.engine.local_checkpoint})
+        self._apply_recovery_ops(inst, fin["gap_ops"], fin["primary_term"])
+        inst.engine.fill_seqno_gaps(fin["max_seq_no"])
+        inst.known_global_checkpoint = max(
+            inst.known_global_checkpoint, fin["global_checkpoint"])
+        inst.engine.flush()
+
+    @staticmethod
+    def _apply_recovery_ops(inst: ShardInstance, ops: List[dict],
+                            term: int) -> None:
+        for op in ops:
+            if op["op"] == "index":
+                inst.engine.index(op["id"], op.get("source"),
+                                  seq_no=op["seq_no"], op_primary_term=term)
+            else:
+                inst.engine.delete(op["id"], seq_no=op["seq_no"],
+                                   op_primary_term=term)
+
+    # ---------------- primary promotion + resync ----------------
+
+    def promote_to_primary(self, inst: ShardInstance, new_term: int) -> None:
+        """This copy was promoted by the master: fence, fill gaps, build the
+        primary-side tracker, then resync every surviving copy over the
+        transport (ref: IndexShard primary promotion +
+        PrimaryReplicaSyncer.java)."""
+        with inst.lock:
+            gcp = inst.known_global_checkpoint
+            inst.engine.advance_primary_term(new_term)
+            inst.engine.fill_seqno_gaps(inst.engine.max_seq_no)
+            inst.primary = True
+            inst.primary_term = new_term
+            inst.tracker = ReplicationTracker(inst.allocation_id)
+            inst.tracker.update_local_checkpoint(
+                inst.allocation_id, inst.engine.local_checkpoint)
+        state = self.state
+        for r in state.shard_copies(inst.index, inst.shard_id):
+            if r.allocation_id == inst.allocation_id or r.node_id is None:
+                continue
+            if r.state != "STARTED":
+                continue
+            try:
+                self._resync_copy(inst, r, gcp, new_term)
+            except Exception as e:  # noqa: BLE001
+                self._report_shard_failed(inst.index, inst.shard_id,
+                                          r.allocation_id, str(e))
+
+    def _resync_copy(self, inst: ShardInstance, r: ShardRouting,
+                     gcp: int, new_term: int) -> None:
+        shard_ref = {"index": inst.index, "shard_id": inst.shard_id}
+        prep = self.channels.request(
+            r.node_id, "internal:index/shard/resync/prepare",
+            {**shard_ref, "primary_term": new_term, "above_seq_no": gcp})
+        doc_states = {d: inst.engine.doc_resync_state(d)
+                      for d in prep["divergent"]}
+        replay_from = min(gcp, prep["local_checkpoint"])
+        ops = inst.engine.changes_since(replay_from)
+        resp = self.channels.request(
+            r.node_id, "internal:index/shard/resync/apply",
+            {**shard_ref, "primary_term": new_term,
+             "doc_states": doc_states, "replay_from": replay_from,
+             "ops": ops, "max_seq_no": inst.engine.max_seq_no})
+        inst.tracker.add_tracking(r.allocation_id)
+        inst.tracker.update_local_checkpoint(
+            r.allocation_id, resp["local_checkpoint"])
+        inst.tracker.mark_in_sync(r.allocation_id)
+
+    def _on_resync_prepare(self, req) -> dict:
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        term = p["primary_term"]
+        if term < inst.primary_term:
+            raise PrimaryTermMismatchError(
+                f"resync from deposed primary (term [{term}])")
+        with inst.lock:
+            inst.engine.advance_primary_term(term)
+            inst.primary_term = term
+            return {"divergent": inst.engine.docs_above(p["above_seq_no"]),
+                    "local_checkpoint": inst.engine.local_checkpoint}
+
+    def _on_resync_apply(self, req) -> dict:
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        with inst.lock:
+            resync_target_apply(inst.engine, p["primary_term"],
+                                p["doc_states"], p["replay_from"],
+                                p["ops"], p["max_seq_no"])
+            inst.primary_term = p["primary_term"]
+            return {"local_checkpoint": inst.engine.local_checkpoint}

@@ -1,0 +1,109 @@
+"""Node-to-node request channels for the distributed data/control plane.
+
+The reference routes every inter-node call through TransportService
+connections looked up from the cluster state's DiscoveryNodes (ref:
+transport/TransportService.java sendRequest(DiscoveryNode, ...)). Here the
+same seam is a small synchronous interface so the SAME spine code (shard
+replication, peer recovery, search fan-out, master actions) runs:
+
+  * in one process for the deterministic multi-node tests
+    (LocalNodeChannels — direct dispatch, with kill support to simulate
+    node death, and an optional fault hook for injected failures);
+  * over real framed TCP between live nodes (TcpNodeChannels — address
+    book fed from the cluster state / discovery).
+
+Requests address nodes by node NAME (the stable operator-facing identity;
+coordination uses the same convention, see cluster/cluster_service.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.transport.service import TransportService
+
+
+class NodeUnavailableError(ElasticsearchTpuError):
+    status = 503
+    error_type = "node_not_connected_exception"
+
+
+class NodeChannels:
+    """request() raises NodeUnavailableError when the target is down."""
+
+    def request(self, node: str, action: str, payload: dict) -> dict:
+        raise NotImplementedError
+
+
+class LocalNodeChannels(NodeChannels):
+    """In-process dispatch between TransportServices, by node name."""
+
+    def __init__(self):
+        self._services: Dict[str, TransportService] = {}
+        self._killed: set = set()
+        self._lock = threading.Lock()
+        # test seam: fault(from_node?, to_node, action) -> raise to inject
+        self.fault_hook: Optional[Callable[[str, str], None]] = None
+
+    def register(self, name: str, service: TransportService) -> None:
+        with self._lock:
+            self._services[name] = service
+            self._killed.discard(name)
+
+    def kill(self, name: str) -> None:
+        with self._lock:
+            self._killed.add(name)
+
+    def revive(self, name: str) -> None:
+        with self._lock:
+            self._killed.discard(name)
+
+    def request(self, node: str, action: str, payload: dict) -> dict:
+        with self._lock:
+            if node in self._killed or node not in self._services:
+                raise NodeUnavailableError(f"node [{node}] is not connected")
+            service = self._services[node]
+        if self.fault_hook is not None:
+            self.fault_hook(node, action)
+        return service.handle(action, payload, source_node="local")
+
+
+class TcpNodeChannels(NodeChannels):
+    """Framed-TCP dispatch using an address book (host, port) by name."""
+
+    def __init__(self, self_name: str, self_service: TransportService,
+                 timeout: float = 30.0):
+        self.self_name = self_name
+        self.self_service = self_service
+        self.timeout = timeout
+        self._addresses: Dict[str, Tuple[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def set_address(self, name: str, host: str, port: int) -> None:
+        with self._lock:
+            self._addresses[name] = (host, port)
+
+    def update_from_state(self, state) -> None:
+        """Learn peer addresses from the applied cluster state."""
+        for n in state.nodes.values():
+            if ":" in (n.address or ""):
+                host, port = n.address.rsplit(":", 1)
+                self.set_address(n.name, host, int(port))
+
+    def request(self, node: str, action: str, payload: dict) -> dict:
+        if node == self.self_name:
+            # local short-circuit, as the reference does for local sends
+            return self.self_service.handle(action, payload, source_node=node)
+        with self._lock:
+            addr = self._addresses.get(node)
+        if addr is None:
+            raise NodeUnavailableError(f"no known address for node [{node}]")
+        try:
+            return TransportService.send_remote(
+                addr[0], addr[1], action, payload,
+                source_node=self.self_name, timeout=self.timeout)
+        except (ConnectionError, OSError, TimeoutError) as e:
+            raise NodeUnavailableError(
+                f"node [{node}] unreachable: {e}") from e

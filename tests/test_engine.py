@@ -185,3 +185,72 @@ def test_replication_tracker_global_checkpoint():
     assert rt.global_checkpoint == 7
     rt.remove_tracking("r1")
     assert rt.global_checkpoint == 9
+
+
+def test_segment_payloads_install_roundtrip(tmp_path):
+    """File-phase recovery transfer: payloads from one engine install into
+    an empty one with identical docs, deletes, and seqno state."""
+    src = make_engine()
+    for i in range(20):
+        src.index(str(i), {"n": i, "body": f"doc {i}"})
+    src.delete("3")
+    src.refresh()
+    src.index("5", {"n": 55, "body": "updated five"})  # cross-segment update
+    payloads, max_seq = src.segment_payloads()
+    assert max_seq == src.max_seq_no
+
+    dst = make_engine(str(tmp_path / "dst"))
+    for blob, live in payloads:
+        dst.install_segment(blob, live)
+    dst.fill_seqno_gaps(max_seq)
+    assert dst.doc_count() == src.doc_count() == 19
+    assert dst.get("3") is None
+    assert dst.get("5")["_source"] == {"n": 55, "body": "updated five"}
+    assert dst.local_checkpoint == max_seq
+
+    # installed segments got LOCAL seg ids: flush + crash-recover stays sane
+    dst.flush()
+    dst.close()
+    recovered = make_engine(str(tmp_path / "dst"))
+    assert recovered.doc_count() == 19
+    assert recovered.get("5")["_source"]["n"] == 55
+
+
+def test_install_segment_remaps_colliding_seg_ids(tmp_path):
+    """A locally-refreshed segment and an installed one must never share a
+    seg id, or flush()'s dedup-by-filename corrupts the commit."""
+    src = make_engine()
+    src.index("a", {"n": 1, "body": "one"})
+    src.refresh()
+    payloads, max_seq = src.segment_payloads()
+
+    dst = make_engine(str(tmp_path / "dst"))
+    # local write + refresh first: local segment takes seg_id 0
+    dst.index("b", {"n": 2, "body": "two"}, seq_no=99)
+    dst.refresh()
+    for blob, live in payloads:
+        dst.install_segment(blob, live)
+    ids = [s.seg_id for s in dst._segments]
+    assert len(ids) == len(set(ids)), f"colliding seg ids {ids}"
+    dst.flush()
+    dst.close()
+    recovered = make_engine(str(tmp_path / "dst"))
+    assert recovered.doc_count() == 2
+    assert recovered.get("a") is not None and recovered.get("b") is not None
+
+
+def test_install_segment_racing_live_write_wins():
+    """A replicated write that raced ahead of the phase1 transfer must not
+    be clobbered by the installed (older) copy of the same doc."""
+    src = make_engine()
+    src.index("x", {"n": 1, "body": "old"})
+    src.refresh()
+    payloads, _ = src.segment_payloads()
+
+    dst = make_engine()
+    dst.index("x", {"n": 2, "body": "new"}, seq_no=7)  # live op, higher seqno
+    for blob, live in payloads:
+        dst.install_segment(blob, live)
+    assert dst.get("x")["_source"]["n"] == 2
+    dst.refresh()
+    assert dst.doc_count() == 1
