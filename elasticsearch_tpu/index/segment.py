@@ -249,17 +249,24 @@ def build_field_postings(
     token_docs: np.ndarray,    # [n_tokens] doc ord of each token
     token_terms: np.ndarray,   # [n_tokens] term ord of each token
     term_names: List[str],     # term ord -> term string (sorted)
+    token_pos: np.ndarray | None = None,  # [n_tokens] position within its doc
 ) -> FieldPostings:
     """Columnar bulk postings build: token arrays -> block postings, fully
     vectorized (the analog of Lucene's flush from sorted (term, doc) pairs,
     ref: Lucene87 postings writer) — indexes millions of docs in seconds
-    where the per-doc builder path takes minutes. Positions are not recorded
-    (bulk-loaded fields serve match/term scoring; phrase needs the doc-at-a-
-    time builder)."""
+    where the per-doc builder path takes minutes. When `token_pos` is given,
+    the positions CSR is recorded too (phrase/highlight support); the sort
+    groups (term, doc) runs with ascending positions, matching the per-doc
+    SegmentBuilder layout."""
     n_docs = len(doc_lens)
     n_terms = len(term_names)
     # tf per (term, doc): unique over a combined key, sorted by term then doc
     key = token_terms.astype(np.int64) * n_docs + token_docs.astype(np.int64)
+    if token_pos is not None:
+        # group-order tokens by (term, doc) with positions ascending inside a
+        # group: np.unique's ascending uniq matches this lexsort's group order
+        order = np.lexsort((token_pos, token_docs, token_terms))
+        pos_sorted = np.ascontiguousarray(token_pos[order]).astype(np.int32)
     uniq, tf = np.unique(key, return_counts=True)
     term_ord = (uniq // n_docs).astype(np.int32)
     doc_ord = (uniq % n_docs).astype(np.int32)
@@ -298,6 +305,12 @@ def build_field_postings(
     if nz.any():
         total_tf[nz] = np.add.reduceat(tf.astype(np.int64), term_offsets[:-1][nz])
 
+    pos_start = np.zeros(len(uniq) + 1, np.int64)
+    pos_data = np.empty(0, np.int32)
+    if token_pos is not None:
+        np.cumsum(tf, out=pos_start[1:])
+        pos_data = pos_sorted
+
     return FieldPostings(
         field=field,
         term_to_ord={t: i for i, t in enumerate(term_names)},
@@ -311,8 +324,8 @@ def build_field_postings(
         block_max_tf=block_max_tf,
         post_start=post_start,
         post_doc=doc_ord,
-        pos_start=np.zeros(len(uniq) + 1, np.int64),
-        pos_data=np.empty(0, np.int32),
+        pos_start=pos_start,
+        pos_data=pos_data,
         doc_len=doc_lens.astype(np.float32),
         sum_doc_len=float(doc_lens.sum()),
     )
