@@ -308,7 +308,9 @@ def build_field_postings(
     pos_start = np.zeros(len(uniq) + 1, np.int64)
     pos_data = np.empty(0, np.int32)
     if token_pos is not None:
-        np.cumsum(tf, out=pos_start[1:])
+        # int64 accumulation: a f32 cumsum silently loses exactness past
+        # 2^24 total positions (reachable at the 10M-doc bench scale)
+        np.cumsum(tf.astype(np.int64), out=pos_start[1:])
         pos_data = pos_sorted
 
     return FieldPostings(

@@ -41,7 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from elasticsearch_tpu.ops import bm25_idf, next_bucket
 from elasticsearch_tpu.parallel.spmd import (
-    StackedBM25, _merge_gathered, _segmented_run_sums,
+    B, K1, StackedBM25, _merge_gathered, _segmented_run_sums,
 )
 
 HOT_DF_FRACTION = 8     # df > total_docs/8 -> dense column
@@ -473,6 +473,67 @@ class BlockMaxBM25:
         packed = _acc_topk(acc, self.hot_cols, self.stacked.live,
                            jnp.asarray(W), mesh=self.mesh, k=k)
         return np.asarray(packed)[0]
+
+    def search_phrase(self, phrases: Sequence[List[str]], k: int = 10,
+                      slop: int = 0,
+                      live_host: Sequence[np.ndarray] | None = None):
+        """Batched exact match_phrase top-k (ref: Lucene PhraseQuery via
+        PhraseScorer; BASELINE config 3).
+
+        The conjunction + positional verify runs as columnar host passes
+        (index/positions.py — candidate sets after intersection are tiny, a
+        device round trip would dominate), scoring is BM25 over the phrase
+        frequency with summed idf, matching the dense executor's
+        _exec_MatchPhraseQuery semantics exactly. Returns
+        (scores [Q,k], shard [Q,k], ord [Q,k]) with doc-order tie-break."""
+        from elasticsearch_tpu.index.positions import phrase_freqs
+
+        st = self.stacked
+        Q = len(phrases)
+        out_s = np.zeros((Q, k), np.float32)
+        out_shard = np.zeros((Q, k), np.int32)
+        out_ord = np.zeros((Q, k), np.int32)
+        for qi, terms in enumerate(phrases):
+            idf_sum = 0.0
+            for t in terms:
+                df_t = sum(
+                    int(fp.doc_freq[fp.term_to_ord[t]]) if t in fp.term_to_ord else 0
+                    for fp in st.postings)
+                if df_t:
+                    idf_sum += bm25_idf(st.total_docs, df_t)
+            all_s: List[np.ndarray] = []
+            all_shard: List[np.ndarray] = []
+            all_ord: List[np.ndarray] = []
+            for s in range(self.S):
+                fp = st.postings[s]
+                docs, pf = phrase_freqs(fp, list(terms), slop=slop)
+                if live_host is not None and len(docs):
+                    keep = live_host[s][docs]
+                    docs, pf = docs[keep], pf[keep]
+                if not len(docs):
+                    continue
+                dl = fp.doc_len[docs]
+                denom = pf + K1 * (1.0 - B + B * dl / max(st.avgdl, 1e-9))
+                sc = (idf_sum * pf * (K1 + 1.0) / denom).astype(np.float32)
+                if len(sc) > k:
+                    # stable (score desc, doc asc) selection so tied scores
+                    # keep the lowest doc ords — same tie-break as the final
+                    # cross-shard merge below
+                    part = np.lexsort((docs, -sc))[:k]
+                    docs, sc = docs[part], sc[part]
+                all_s.append(sc)
+                all_shard.append(np.full(len(sc), s, np.int32))
+                all_ord.append(docs.astype(np.int32))
+            if not all_s:
+                continue
+            sc = np.concatenate(all_s)
+            sh = np.concatenate(all_shard)
+            od = np.concatenate(all_ord)
+            order = np.lexsort((od, sh, -sc))[:k]
+            out_s[qi, : len(order)] = sc[order]
+            out_shard[qi, : len(order)] = sh[order]
+            out_ord[qi, : len(order)] = od[order]
+        return out_s, out_shard, out_ord
 
     def _is_sparse(self, term: str) -> bool:
         meta = self._terms.get(term)

@@ -27,6 +27,7 @@ import numpy as np
 
 from elasticsearch_tpu.common.errors import IllegalArgumentError, ParsingError
 from elasticsearch_tpu.index.engine import EngineSearcher, SegmentView
+from elasticsearch_tpu.index.positions import phrase_freqs
 from elasticsearch_tpu.index.segment import Segment
 from elasticsearch_tpu.mapper.field_types import parse_date_millis
 from elasticsearch_tpu.mapper.mapper_service import MapperService
@@ -227,20 +228,11 @@ class QueryExecutor:
         fp = leaf.segment.postings.get(query.field)
         if fp is None:
             return self._none(leaf)
-        # candidate set: all terms present (host CSR intersection — exact)
-        cand = None
-        for t in terms:
-            o = fp.ord(t)
-            if o < 0:
-                return self._none(leaf)
-            docs = fp.post_doc[int(fp.post_start[o]): int(fp.post_start[o + 1])]
-            cand = docs if cand is None else np.intersect1d(cand, docs, assume_unique=True)
-            if len(cand) == 0:
-                return self._none(leaf)
+        # columnar positional verify: all candidates in a few array passes
+        # (index/positions.py), no per-doc loop
+        docs, freqs = phrase_freqs(fp, terms, slop=query.slop)
         phrase_freq = np.zeros(leaf.n_docs, np.float32)
-        for doc in cand:
-            pf = _phrase_freq([fp.positions(t, int(doc)) for t in terms], query.slop)
-            phrase_freq[int(doc)] = pf
+        phrase_freq[docs] = freqs
         idf_sum = sum(self.stats.idf(query.field, t) for t in terms)
         avgdl = self.stats.avgdl(query.field)
         dl = fp.doc_len
@@ -464,46 +456,3 @@ class QueryExecutor:
         return mask.astype(jnp.float32), mask
 
 
-def _phrase_freq(positions: List[np.ndarray], slop: int) -> float:
-    """Count phrase occurrences given per-term position arrays.
-
-    slop=0: exact adjacency. slop>0: within-window matches (a simplified
-    sloppy matcher: term i may appear at first_pos + i ± slop, order-checked
-    for slop=0 only, mirroring common usage rather than Lucene's full edit
-    distance semantics)."""
-    if any(len(p) == 0 for p in positions):
-        return 0.0
-    if slop == 0:
-        base = positions[0]
-        count = 0
-        for p0 in base:
-            if all((p0 + i) in positions[i] for i in range(1, len(positions))):
-                count += 1
-        return float(count)
-    count = 0
-    pos_sets = [set(p.tolist()) for p in positions]
-    for p0 in positions[0]:
-        for offsets in _window_offsets(len(positions), slop):
-            if all((p0 + i + offsets[i]) in pos_sets[i] for i in range(1, len(positions))):
-                count += 1
-                break
-    return float(count)
-
-
-def _window_offsets(n_terms: int, slop: int):
-    """Enumerate per-term displacement tuples with total displacement <= slop."""
-    if n_terms == 2:
-        for d in range(-slop, slop + 1):
-            yield (0, d)
-        return
-    # bounded enumeration for longer phrases
-    def rec(i, remaining):
-        if i == n_terms:
-            yield ()
-            return
-        for d in range(-remaining, remaining + 1):
-            for rest in rec(i + 1, remaining - abs(d)):
-                yield (d,) + rest
-
-    for offs in rec(1, slop):
-        yield (0,) + offs
