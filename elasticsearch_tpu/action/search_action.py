@@ -215,6 +215,8 @@ class SearchActionService:
             "hits": {"total": {"value": total, "relation": relation},
                      "max_score": max_score, "hits": hits_out},
         }
+        if body.get("track_total_hits") is False:
+            resp["hits"].pop("total")   # ref: ES omits total when untracked
         if aggs_out is not None:
             resp["aggregations"] = aggs_out
         return resp

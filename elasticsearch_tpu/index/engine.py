@@ -389,6 +389,14 @@ class InternalEngine:
             ]
             return EngineSearcher(views)
 
+    def searcher_version(self) -> tuple:
+        """Cheap identity of what acquire_searcher would return — no live-mask
+        copies. Serving-snapshot caches key on this (ref: Lucene reader
+        version as used by the shard request cache)."""
+        with self._lock:
+            return tuple((id(s), self._live_epochs[i])
+                         for i, s in enumerate(self._segments))
+
     # ---------------- refresh / flush / merge ----------------
 
     def refresh(self) -> bool:

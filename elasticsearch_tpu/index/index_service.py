@@ -50,6 +50,9 @@ class IndexService:
             self.shards.append(
                 InternalEngine(self.mapper, data_path=path, translog_durability=durability)
             )
+        from elasticsearch_tpu.search.serving import ServingContext
+
+        self.serving = ServingContext(self)
 
     # ---- document ops ----
 
@@ -87,6 +90,35 @@ class IndexService:
     # ---- search (scatter-gather across shards) ----
 
     def search(self, request: dict, search_type: str = "query_then_fetch") -> dict:
+        fast = self.serving.try_search(request, search_type)
+        if fast is not None:
+            return fast
+        return self._search_dense(request, search_type)
+
+    def msearch(self, requests: List[dict],
+                search_type: str = "query_then_fetch") -> List[dict]:
+        """Batched search: eligible flat queries ride ONE device dispatch
+        through the blockmax serving path (ref P8/SURVEY §2.10: batch many
+        queries per step); the rest run the dense path individually.
+
+        Per-body error isolation (ref: _msearch contract — one bad body must
+        not fail its neighbors): failures come back as the exception object
+        in that body's slot for the caller to render."""
+        from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+        out = self.serving.try_msearch(requests, search_type)
+        results: List = []
+        for i, r in enumerate(out):
+            if r is not None:
+                results.append(r)
+                continue
+            try:
+                results.append(self._search_dense(requests[i], search_type))
+            except ElasticsearchTpuError as e:
+                results.append(e)
+        return results
+
+    def _search_dense(self, request: dict, search_type: str = "query_then_fetch") -> dict:
         import time as _time
 
         from elasticsearch_tpu.search.query_phase import QuerySearchResult, _sort_key, parse_sort
@@ -149,6 +181,8 @@ class IndexService:
                 "hits": hits,
             },
         }
+        if request.get("track_total_hits") is False:
+            resp["hits"].pop("total")   # ref: ES omits total when untracked
         if aggs is not None:
             resp["aggregations"] = aggs
         return resp

@@ -41,7 +41,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from elasticsearch_tpu.ops import bm25_idf, next_bucket
 from elasticsearch_tpu.parallel.spmd import (
-    B, K1, StackedBM25, _merge_gathered, _segmented_run_sums,
+    B, K1, StackedBM25, _dense_topk_tiebreak, _gather_parts, _merge_gathered,
+    _segmented_run_sums,
 )
 
 HOT_DF_FRACTION = 8     # df > total_docs/8 -> dense column
@@ -581,20 +582,22 @@ def _one_query_topk(d, s, dense, live, k):
     is_last = jnp.concatenate([d[1:] != d[:-1], jnp.ones(1, bool)])
     lane_tot = tot + jnp.take(dense, d)
     ok = is_last & (tot > 0) & jnp.take(live, d)
-    cand2_s, idx = jax.lax.top_k(jnp.where(ok, lane_tot, -jnp.inf), k)
-    cand2_d = jnp.take(d, idx)
-    cand1_s, cand1_d = jax.lax.top_k(
+    # lane candidates ranked by (score desc, doc asc) — doc-id tie-break
+    neg2, cand2_d = jax.lax.sort(
+        (-jnp.where(ok, lane_tot, -jnp.inf), d), num_keys=2)
+    cand2_s, cand2_d = -neg2[:k], cand2_d[:k]
+    cand1_s, cand1_d = _dense_topk_tiebreak(
         jnp.where(live & (dense > 0), dense, -jnp.inf), k)
     ms = jnp.concatenate([cand1_s, cand2_s])
     md = jnp.concatenate([cand1_d.astype(jnp.int32), cand2_d])
-    # dedup by doc, keeping the best score: stable order by (doc, -score)
-    ord2 = jnp.lexsort((-ms, md))
-    ms2 = jnp.take(ms, ord2)
-    md2 = jnp.take(md, ord2)
+    # dedup by doc, keeping the best score: order by (doc asc, score desc)
+    md2, neg_ms2 = jax.lax.sort((md, -ms), num_keys=2)
+    ms2 = -neg_ms2
     first = jnp.concatenate([jnp.ones(1, bool), md2[1:] != md2[:-1]])
     final = jnp.where(first & (ms2 > -jnp.inf), ms2, -jnp.inf)
-    top_s, ti = jax.lax.top_k(final, k)
-    return top_s, jnp.take(md2, ti)
+    # final rank by (score desc, doc asc)
+    neg_f, md3 = jax.lax.sort((-final, md2), num_keys=2)
+    return -neg_f[:k], md3[:k]
 
 
 @partial(jax.jit, static_argnames=("mesh",), donate_argnums=(2,))
@@ -608,9 +611,12 @@ def _scatter_chunk(block_docs, block_scores, acc, qb, qw, *, mesh):
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard")),
         out_specs=P("shard"), check_vma=False)
     def program(bd, bs, acc, qb, qw):
-        docs = jnp.take(bd[0], qb[0], axis=0)            # [C, 128]
-        sc = qw[0][:, None] * jnp.take(bs[0], qb[0], axis=0)
-        return acc[0].at[docs.ravel()].add(sc.ravel())[None]
+        def one_part(bd1, bs1, acc1, qb1, qw1):
+            docs = jnp.take(bd1, qb1, axis=0)            # [C, 128]
+            sc = qw1[:, None] * jnp.take(bs1, qb1, axis=0)
+            return acc1.at[docs.ravel()].add(sc.ravel())
+
+        return jax.vmap(one_part)(bd, bs, acc, qb, qw)
 
     return program(block_docs, block_scores, acc, qb, qw)
 
@@ -626,17 +632,20 @@ def _acc_topk(acc, hot_cols, live, W, *, mesh, k):
         in_specs=(P("shard"), P("shard"), P("shard"), P()),
         out_specs=P(), check_vma=False)
     def program(acc, hc, lv, W):
-        dense = jax.lax.dot_general(                     # [1, D]
-            W, hc[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
-        a = acc[0][None]
-        tot = a + dense
-        ok = lv[0][None] & ((a > 0) | (dense > 0))
-        s, o = jax.lax.top_k(jnp.where(ok, tot, -jnp.inf), k)
-        g_s = jax.lax.all_gather(s, "shard")             # [S, 1, k]
-        g_o = jax.lax.all_gather(o.astype(jnp.int32), "shard")
-        top_s, shard_of, ord_of = _merge_gathered(g_s, g_o, k)
+        def one_part(acc1, hc1, lv1):
+            dense = jax.lax.dot_general(                 # [1, D]
+                W, hc1, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+            a = acc1[None]
+            tot = a + dense
+            ok = lv1[None] & ((a > 0) | (dense > 0))
+            s, o = _dense_topk_tiebreak(jnp.where(ok, tot, -jnp.inf), k)
+            return s, o.astype(jnp.int32)
+
+        s, o = jax.vmap(one_part)(acc, hc, lv)           # [Sl, 1, k]
+        top_s, shard_of, ord_of = _merge_gathered(
+            _gather_parts(s), _gather_parts(o), k)
         return jnp.stack(
             [top_s,
              jax.lax.bitcast_convert_type(shard_of, jnp.float32),
@@ -664,26 +673,27 @@ def _hybrid_program(block_docs, block_scores, live, hot_cols, W, qblocks, qidf,
         check_vma=False,
     )
     def program(block_docs, block_scores, live, hot_cols, W, qb, qi):
-        bd, bs, lv, hc = block_docs[0], block_scores[0], live[0], hot_cols[0]
-        qb = qb[:, 0]                                   # [Qc, B]
-        qi = qi[:, 0]
-        # HIGHEST: the TPU MXU multiplies bf16 by default, which shifts
-        # scores ~1% and breaks exact top-k parity; H is tiny so the 6-pass
-        # f32 emulation is free
-        dense = jax.lax.dot_general(                    # [Qc, D]
-            W, hc, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
-        docs = jnp.take(bd, qb, axis=0)                 # [Qc, B, 128]
-        sc = qi[:, :, None] * jnp.take(bs, qb, axis=0)
-        Qc = qb.shape[0]
-        d2 = docs.reshape(Qc, -1)
-        s2 = sc.reshape(Qc, -1)
+        def one_part(bd, bs, lv, hc, qb1, qi1):         # qb1 [Qc, B]
+            # HIGHEST: the TPU MXU multiplies bf16 by default, which shifts
+            # scores ~1% and breaks exact top-k parity; H is tiny so the
+            # 6-pass f32 emulation is free
+            dense = jax.lax.dot_general(                # [Qc, D]
+                W, hc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)
+            docs = jnp.take(bd, qb1, axis=0)            # [Qc, B, 128]
+            sc = qi1[:, :, None] * jnp.take(bs, qb1, axis=0)
+            Qc = qb1.shape[0]
+            d2 = docs.reshape(Qc, -1)
+            s2 = sc.reshape(Qc, -1)
+            return jax.vmap(
+                lambda d, s, dn: _one_query_topk(d, s, dn, lv, k))(d2, s2, dense)
+
         s_scores, s_ords = jax.vmap(
-            lambda d, s, dn: _one_query_topk(d, s, dn, lv, k))(d2, s2, dense)
-        g_s = jax.lax.all_gather(s_scores, "shard")     # [S, Qc, k]
-        g_o = jax.lax.all_gather(s_ords, "shard")
-        top_s, shard_of, ord_of = _merge_gathered(g_s, g_o, k)
+            one_part, in_axes=(0, 0, 0, 0, 1, 1))(
+            block_docs, block_scores, live, hot_cols, qb, qi)  # [Sl, Qc, k]
+        top_s, shard_of, ord_of = _merge_gathered(
+            _gather_parts(s_scores), _gather_parts(s_ords), k)
         return jnp.stack(
             [top_s,
              jax.lax.bitcast_convert_type(shard_of, jnp.float32),

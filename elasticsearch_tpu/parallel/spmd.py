@@ -334,21 +334,53 @@ def _local_bm25_topk(block_docs, block_tfs, doc_len, live, qblocks, qidf, avgdl,
         is_last = jnp.concatenate([d[1:] != d[:-1], jnp.ones(1, bool)])
         ok = is_last & (total > 0) & jnp.take(live, d)
         masked = jnp.where(ok, total, -jnp.inf)
-        top_s, idx = jax.lax.top_k(masked, k)
-        return top_s, jnp.take(d, idx)
+        # (score desc, doc asc) rank — doc-id tie-break, Lucene semantics
+        neg_s, d_s = jax.lax.sort((-masked, d), num_keys=2)
+        return -neg_s[:k], d_s[:k]
 
     return jax.vmap(one_query)(qblocks, qidf)
 
 
+def _dense_topk_tiebreak(sc, k):
+    """Top-k of dense scores over the last axis with ASCENDING-index
+    tie-break (Lucene semantics: equal scores rank by doc id).
+
+    A full sort of [.., D] would cost O(D log D) per query; instead two
+    O(D log k) top_k passes: (1) plain top-k fixes the k-th score theta and
+    every doc strictly above it, (2) among docs scoring exactly theta, top_k
+    of -index picks the smallest ids. Ranking the 2k merged candidates by
+    (score desc, index asc) is then exact: at most k-1 docs are strictly
+    above theta, and ties at theta fill the rest in id order.
+    Returns (scores [..., k], indices [..., k] i32)."""
+    s1, o1 = jax.lax.top_k(sc, k)
+    theta = jax.lax.slice_in_dim(s1, k - 1, k, axis=-1)
+    at = sc == theta
+    iota = jax.lax.broadcasted_iota(jnp.int32, sc.shape, sc.ndim - 1)
+    neg = jnp.where(at, -iota, jnp.iinfo(jnp.int32).min)
+    v2, o2 = jax.lax.top_k(neg, k)
+    valid2 = (v2 > jnp.iinfo(jnp.int32).min) & (theta > -jnp.inf)
+    cs = jnp.where(s1 > theta, s1, -jnp.inf)
+    bs = jnp.where(valid2, jnp.broadcast_to(theta, v2.shape), -jnp.inf)
+    ms = jnp.concatenate([cs, bs], axis=-1)
+    mo = jnp.concatenate([o1.astype(jnp.int32), o2.astype(jnp.int32)], axis=-1)
+    neg_ms, mo_s = jax.lax.sort((-ms, mo), num_keys=2, dimension=ms.ndim - 1)
+    return (-jax.lax.slice_in_dim(neg_ms, 0, k, axis=-1),
+            jax.lax.slice_in_dim(mo_s, 0, k, axis=-1))
+
+
 def _merge_gathered(scores_g, ords_g, k):
-    """[S, Q, k] gathered results -> per-query global top-k with provenance."""
+    """[S, Q, k] gathered results -> per-query global top-k with provenance.
+
+    Ties rank by (shard asc, ord asc) so the distributed merge is
+    deterministic and matches a single-partition run (Lucene doc-id order)."""
     S, Q, _ = scores_g.shape
     flat_s = jnp.transpose(scores_g, (1, 0, 2)).reshape(Q, S * k)
-    flat_o = jnp.transpose(ords_g, (1, 0, 2)).reshape(Q, S * k)
-    top_s, idx = jax.lax.top_k(flat_s, k)                # [Q, k]
-    shard_of = (idx // k).astype(jnp.int32)
-    ord_of = jnp.take_along_axis(flat_o, idx, axis=1)
-    return top_s, shard_of, ord_of
+    flat_o = jnp.transpose(ords_g, (1, 0, 2)).reshape(Q, S * k).astype(jnp.int32)
+    shard_idx = jnp.broadcast_to(
+        (jnp.arange(S * k, dtype=jnp.int32) // k)[None, :], flat_s.shape)
+    neg_s, shard_of, ord_of = jax.lax.sort(
+        (-flat_s, shard_idx, flat_o), num_keys=3, dimension=1)
+    return (-neg_s[:, :k], shard_of[:, :k], ord_of[:, :k])
 
 
 @partial(jax.jit, static_argnames=("mesh", "k"))
@@ -364,15 +396,27 @@ def _bm25_program(block_docs, block_tfs, doc_len, live, qb, qi, avgdl, *, mesh, 
         check_vma=False,
     )
     def program(block_docs, block_tfs, doc_len, live, qb, qi, avgdl):
-        # local shapes: block_docs [1,T,128]; qb [Qd, 1, B]
-        s_scores, s_ords = _local_bm25_topk(
-            block_docs[0], block_tfs[0], doc_len[0], live[0], qb[:, 0], qi[:, 0], avgdl, k)
-        g_scores = jax.lax.all_gather(s_scores, "shard")   # [S, Qd, k]
-        g_ords = jax.lax.all_gather(s_ords, "shard")
+        # local shapes: block_docs [Sl,T,128]; qb [Qd, Sl, B]. A device may
+        # hold SEVERAL partitions (segments/shards per chip) — vmap over them
+        s_scores, s_ords = jax.vmap(
+            lambda bd, bt, dl, lv, b, i: _local_bm25_topk(
+                bd, bt, dl, lv, b, i, avgdl, k),
+            in_axes=(0, 0, 0, 0, 1, 1))(
+            block_docs, block_tfs, doc_len, live, qb, qi)   # [Sl, Qd, k]
+        g_scores = _gather_parts(s_scores)                  # [S, Qd, k]
+        g_ords = _gather_parts(s_ords)
         top_s, shard_of, ord_of = _merge_gathered(g_scores, g_ords, k)
         return top_s, shard_of, ord_of
 
     return program(block_docs, block_tfs, doc_len, live, qb, qi, avgdl)
+
+
+def _gather_parts(x):
+    """all_gather local [Sl, ...] partition results into global [S, ...]
+    ordered by global partition index (device-major, local-minor — the
+    stacked dim-0 order NamedSharding(P('shard')) splits contiguously)."""
+    g = jax.lax.all_gather(x, "shard")          # [n_dev, Sl, ...]
+    return g.reshape((-1,) + x.shape[1:])
 
 
 def sharded_bm25_topk(
@@ -430,25 +474,25 @@ def _knn_program(vectors_a, norms_a, exists_a, live_a, queries_a, *, mesh, k, si
         check_vma=False,
     )
     def program(vectors, norms, exists, live, q):
-        v = vectors[0]                                     # [D, dims] bf16
-        dots = jax.lax.dot_general(
-            q.astype(jnp.bfloat16), v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)            # [Qd, D]
-        if similarity == "cosine":
-            qn = jnp.linalg.norm(q, axis=-1, keepdims=True)
-            sc = (1.0 + dots / jnp.maximum(qn * norms[0][None, :], 1e-20)) / 2.0
-        elif similarity == "dot_product":
-            sc = (1.0 + dots) / 2.0
-        else:  # l2_norm
-            qq = jnp.sum(q * q, axis=-1, keepdims=True)
-            dd = (norms[0] * norms[0])[None, :]
-            sc = 1.0 / (1.0 + jnp.sqrt(jnp.maximum(qq + dd - 2.0 * dots, 0.0)))
-        ok = exists[0] & live[0]
-        sc = jnp.where(ok[None, :], sc, -jnp.inf)
-        s_scores, s_ords = jax.lax.top_k(sc, k)            # [Qd, k]
-        g_scores = jax.lax.all_gather(s_scores, "shard")
-        g_ords = jax.lax.all_gather(s_ords, "shard")
-        return _merge_gathered(g_scores, g_ords, k)
+        def one_part(v, nrm, ex, lv):                      # v [D, dims] bf16
+            dots = jax.lax.dot_general(
+                q.astype(jnp.bfloat16), v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # [Qd, D]
+            if similarity == "cosine":
+                qn = jnp.linalg.norm(q, axis=-1, keepdims=True)
+                sc = (1.0 + dots / jnp.maximum(qn * nrm[None, :], 1e-20)) / 2.0
+            elif similarity == "dot_product":
+                sc = (1.0 + dots) / 2.0
+            else:  # l2_norm
+                qq = jnp.sum(q * q, axis=-1, keepdims=True)
+                dd = (nrm * nrm)[None, :]
+                sc = 1.0 / (1.0 + jnp.sqrt(jnp.maximum(qq + dd - 2.0 * dots, 0.0)))
+            ok = ex & lv
+            sc = jnp.where(ok[None, :], sc, -jnp.inf)
+            return _dense_topk_tiebreak(sc, k)             # [Qd, k]
+
+        s_scores, s_ords = jax.vmap(one_part)(vectors, norms, exists, live)
+        return _merge_gathered(_gather_parts(s_scores), _gather_parts(s_ords), k)
 
     return program(vectors_a, norms_a, exists_a, live_a, queries_a)
 
@@ -503,16 +547,16 @@ def _column_insert_program(cache, block_docs, block_scores, blks, slots, mesh):
         check_vma=False,
     )
     def program(cache, block_docs, block_scores, blks, slots):
-        c = cache[0]                                     # [C+1, D]
-        docs = jnp.take(block_docs[0], blks[0], axis=0)  # [nT, maxB, 128]
-        vals = jnp.take(block_scores[0], blks[0], axis=0)
-        nT, maxB, _ = docs.shape
-        c = c.at[slots].set(0.0)
-        rows = jnp.broadcast_to(slots[:, None, None], docs.shape)
-        c = c.at[rows.ravel(), docs.reshape(-1)].add(vals.reshape(-1))
-        # lanes with val 0 (padding and the zero block) may have hit (slot, 0);
-        # they add exactly 0.0 so doc 0 stays correct.
-        return c[None]
+        def one_part(c, bd, bs, bl):                     # c [C+1, D]
+            docs = jnp.take(bd, bl, axis=0)              # [nT, maxB, 128]
+            vals = jnp.take(bs, bl, axis=0)
+            c = c.at[slots].set(0.0)
+            rows = jnp.broadcast_to(slots[:, None, None], docs.shape)
+            # lanes with val 0 (padding and the zero block) may hit (slot, 0);
+            # they add exactly 0.0 so doc 0 stays correct.
+            return c.at[rows.ravel(), docs.reshape(-1)].add(vals.reshape(-1))
+
+        return jax.vmap(one_part)(cache, block_docs, block_scores, blks)
 
     return program(cache, block_docs, block_scores, blks, slots)
 
@@ -536,21 +580,23 @@ def _column_score_program(cache, live, qpacked, mesh, k):
         check_vma=False,
     )
     def program(cache, live, qpacked):
-        c = cache[0]                                     # [C+1, D]
         Q = qpacked.shape[0]
         qslots = qpacked[:, 0, :].astype(jnp.int32)
         qweights = qpacked[:, 1, :]
         W = jnp.zeros((Q, C1), jnp.float32)
         W = W.at[jnp.arange(Q)[:, None], qslots].add(qweights)
         W = W.at[:, C1 - 1].set(0.0)                     # drop pad slot
-        scores = jax.lax.dot_general(
-            W, c, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)          # [Q, D]
-        scores = jnp.where(live[0][None, :] & (scores > 0), scores, -jnp.inf)
-        s_scores, s_ords = jax.lax.top_k(scores, k)
-        g_scores = jax.lax.all_gather(s_scores, "shard")
-        g_ords = jax.lax.all_gather(s_ords, "shard")
-        top_s, shard_of, ord_of = _merge_gathered(g_scores, g_ords, k)
+
+        def one_part(c, lv):                             # c [C+1, D]
+            scores = jax.lax.dot_general(
+                W, c, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)      # [Q, D]
+            scores = jnp.where(lv[None, :] & (scores > 0), scores, -jnp.inf)
+            return _dense_topk_tiebreak(scores, k)
+
+        s_scores, s_ords = jax.vmap(one_part)(cache, live)
+        top_s, shard_of, ord_of = _merge_gathered(
+            _gather_parts(s_scores), _gather_parts(s_ords), k)
         # bitcast i32 indices into f32 lanes (not a value cast: ordinals above
         # 2^24 would round under astype); host side views them back as i32
         return jnp.stack(

@@ -50,7 +50,23 @@ class RestResponse:
             return self.body
         if isinstance(self.body, str):
             return self.body.encode()
-        return json.dumps(self.body).encode()
+        return json.dumps(self.body, default=_json_default).encode()
+
+
+def _json_default(o):
+    """Numpy scalars leak into responses from columnar code (sort values,
+    doc values); serialize them as their Python equivalents."""
+    import numpy as np
+
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.bool_):
+        return bool(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"Object of type {type(o).__name__} is not JSON serializable")
 
 
 Handler = Callable[[RestRequest], RestResponse]

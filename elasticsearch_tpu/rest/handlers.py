@@ -466,7 +466,7 @@ class _Handlers:
 
     def msearch(self, req: RestRequest) -> RestResponse:
         lines = [ln for ln in req.raw_body.decode().split("\n") if ln.strip()]
-        responses = []
+        slots = []   # (index_names | None, body, error | None)
         i = 0
         while i + 1 <= len(lines) - 1 or (i < len(lines)):
             header = json.loads(lines[i])
@@ -474,14 +474,39 @@ class _Handlers:
             i += 2
             index = header.get("index", req.param("index", "_all"))
             try:
-                names = self._resolve(index, require=True)
-                if len(names) == 1:
-                    responses.append({**self.node.indices.get(names[0]).search(body), "status": 200})
-                else:
+                slots.append((self._resolve(index, require=True), body, None))
+            except ElasticsearchTpuError as e:
+                slots.append((None, body, e))
+        # single-index bodies group into per-index batches so eligible flat
+        # queries share one device dispatch (ref P8 batched _msearch)
+        by_index: dict = {}
+        for si, (names, body, err) in enumerate(slots):
+            if err is None and len(names) == 1:
+                by_index.setdefault(names[0], []).append(si)
+        batched: dict = {}
+        for name, idxs in by_index.items():
+            try:
+                rs = self.node.indices.get(name).msearch([slots[i][1] for i in idxs])
+                for si, r in zip(idxs, rs):
+                    if isinstance(r, ElasticsearchTpuError):
+                        batched[si] = {"error": r.to_dict(), "status": r.status}
+                    else:
+                        batched[si] = {**r, "status": 200}
+            except ElasticsearchTpuError as e:
+                for si in idxs:
+                    batched[si] = {"error": e.to_dict(), "status": e.status}
+        responses = []
+        for si, (names, body, err) in enumerate(slots):
+            if err is not None:
+                responses.append({"error": err.to_dict(), "status": err.status})
+            elif si in batched:
+                responses.append(batched[si])
+            else:
+                try:
                     responses.append({**self._multi_index_search(names, body, "query_then_fetch"),
                                       "status": 200})
-            except ElasticsearchTpuError as e:
-                responses.append({"error": e.to_dict(), "status": e.status})
+                except ElasticsearchTpuError as e:
+                    responses.append({"error": e.to_dict(), "status": e.status})
         return _ok({"took": sum(r.get("took", 0) for r in responses), "responses": responses})
 
     def count(self, req: RestRequest) -> RestResponse:

@@ -169,7 +169,10 @@ def execute_query_phase(
         collected.sort(key=lambda h: (-h.score, h.global_ord))
         merged = collected[:k]
 
-    window = merged[from_: from_ + size]
+    # the shard returns the full top-(from+size) window; the COORDINATOR
+    # applies `from` after the cross-shard merge (ref: SearchPhaseController
+    # sortDocs — shards cannot know which of their hits the offset skips)
+    window = merged
     max_score = None
     if not sort and merged:
         max_score = max(h.score for h in merged)

@@ -23,8 +23,10 @@ def execute_search(
 ) -> dict:
     start = time.monotonic()
     qr = execute_query_phase(searcher, mapper, request)
-    hits = execute_fetch_phase(searcher, qr.hits, request, index_name)
-    for h, sh in zip(hits, qr.hits):
+    from_ = int(request.get("from", 0))
+    window = qr.hits[from_: from_ + int(request.get("size", 10))]
+    hits = execute_fetch_phase(searcher, window, request, index_name)
+    for h, sh in zip(hits, window):
         if h["_score"] is None and sh.sort_values is None:
             h["_score"] = sh.score
     took = int((time.monotonic() - start) * 1000)
@@ -38,6 +40,8 @@ def execute_search(
             "hits": hits,
         },
     }
+    if request.get("track_total_hits") is False:
+        resp["hits"].pop("total")       # ref: ES omits total when untracked
     if qr.aggregations is not None:
         from elasticsearch_tpu.search.aggregations import finalize_shard_aggs
 

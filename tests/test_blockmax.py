@@ -60,6 +60,24 @@ def draw_queries(rng, n, n_terms=(1, 2, 3)):
     return out
 
 
+def assert_topk_equal(ref, got, q, queries):
+    """Exact-parity assertion: same scores; same (shard, ord) ORDER wherever
+    adjacent scores are separated beyond f32 noise (both paths tie-break by
+    (shard, ord), so only float-rounding near-ties may legitimately swap)."""
+    ref_s, ref_sh, ref_o = ref
+    got_s, got_sh, got_o = got
+    np.testing.assert_allclose(got_s[q], ref_s[q], rtol=2e-5, atol=2e-5)
+    valid = ref_s[q] > -np.inf
+    ref_ids = list(zip(ref_sh[q][valid], ref_o[q][valid]))
+    got_ids = list(zip(got_sh[q][valid], got_o[q][valid]))
+    s = ref_s[q][valid]
+    gaps = np.abs(np.diff(s)) > 2e-5 * np.abs(s[:-1]) + 2e-5
+    if gaps.all():
+        assert got_ids == ref_ids, f"query {q}: {queries[q]}"
+    else:  # near-ties may permute across float noise; sets must still match
+        assert set(got_ids) == set(ref_ids), f"query {q}: {queries[q]}"
+
+
 @pytest.mark.parametrize("n_shards,dp", [(1, 1), (4, 2)])
 def test_blockmax_matches_exhaustive(n_shards, dp):
     rng = np.random.default_rng(17)
@@ -74,16 +92,8 @@ def test_blockmax_matches_exhaustive(n_shards, dp):
     got_s, got_sh, got_o = serving.search(queries, k=10)
 
     for q in range(len(queries)):
-        # same scores to f32 tolerance
-        np.testing.assert_allclose(got_s[q], ref_s[q], rtol=2e-5, atol=2e-5)
-        # same doc set wherever scores are distinct (ties may permute)
-        ref_docs = {(int(sh), int(o)) for sh, o, s in
-                    zip(ref_sh[q], ref_o[q], ref_s[q]) if s > -np.inf}
-        got_docs = {(int(sh), int(o)) for sh, o, s in
-                    zip(got_sh[q], got_o[q], got_s[q]) if s > -np.inf}
-        distinct = len(np.unique(np.round(ref_s[q][ref_s[q] > -np.inf], 4)))
-        if distinct == (ref_s[q] > -np.inf).sum():
-            assert got_docs == ref_docs, f"query {q}: {queries[q]}"
+        assert_topk_equal((ref_s, ref_sh, ref_o), (got_s, got_sh, got_o),
+                          q, queries)
 
 
 def test_blockmax_culls_blocks():
@@ -202,11 +212,5 @@ def test_overflow_path_matches_exhaustive(monkeypatch):
     got_s, got_sh, got_o = serving.search(queries, k=10)
 
     for q in range(len(queries)):
-        np.testing.assert_allclose(got_s[q], ref_s[q], rtol=2e-5, atol=2e-5)
-        ref_docs = {(int(sh), int(o)) for sh, o, s in
-                    zip(ref_sh[q], ref_o[q], ref_s[q]) if s > -np.inf}
-        got_docs = {(int(sh), int(o)) for sh, o, s in
-                    zip(got_sh[q], got_o[q], got_s[q]) if s > -np.inf}
-        distinct = len(np.unique(np.round(ref_s[q][ref_s[q] > -np.inf], 4)))
-        if distinct == (ref_s[q] > -np.inf).sum():
-            assert got_docs == ref_docs, f"query {q}: {queries[q]}"
+        assert_topk_equal((ref_s, ref_sh, ref_o), (got_s, got_sh, got_o),
+                          q, queries)

@@ -1,0 +1,214 @@
+"""Unified serving path: REST-level flat queries must produce IDENTICAL
+results through the blockmax fast path and the dense executor.
+
+VERDICT r2 weak #6 closure test: the same `_search` body runs through
+IndexService.search (fast path engaged when eligible) and _search_dense
+(the dense reference), and hits must match — ids, order (deterministic
+doc-id tie-break on both sides), scores to f32 tolerance, totals exactly.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.state import IndexMetadata
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.search.serving import extract_plan
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta",
+         "iota", "kappa", "lam", "mu", "nu", "xi", "omicron", "pi"]
+TAGS = ["red", "green", "blue", "yellow"]
+
+
+@pytest.fixture(scope="module")
+def svc():
+    meta = IndexMetadata(
+        index="t", uuid="u1", settings=Settings({}),
+        mappings={"properties": {
+            "body": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "n": {"type": "integer"},
+        }})
+    svc = IndexService(meta)
+    rng = np.random.default_rng(31)
+    n_docs = 400
+    for i in range(n_docs):
+        words = rng.choice(WORDS, size=int(rng.integers(3, 20)))
+        svc.index_doc(str(i), {
+            "body": " ".join(words),
+            "tag": str(rng.choice(TAGS)),
+            "n": int(rng.integers(0, 100)),
+        })
+        if i == 150:
+            svc.refresh()       # two segments in shard 0
+    # deletions exercise live masks on both paths
+    for i in range(0, 60, 7):
+        svc.delete_doc(str(i))
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+BODIES = [
+    {"query": {"match": {"body": "alpha beta"}}},
+    {"query": {"match": {"body": "gamma"}}, "size": 25},
+    {"query": {"term": {"body": {"value": "delta", "boost": 2.0}}}},
+    {"query": {"match": {"body": {"query": "alpha beta gamma",
+                                  "operator": "and"}}}},
+    {"query": {"bool": {
+        "must": [{"match": {"body": {"query": "alpha", "operator": "and"}}}],
+        "filter": [{"term": {"tag": "red"}}]}}},
+    {"query": {"bool": {
+        "must": [{"term": {"body": "beta"}}],
+        "should": [{"term": {"body": "gamma"}}, {"term": {"body": "pi"}}],
+        "must_not": [{"term": {"tag": "blue"}}]}}},
+    {"query": {"bool": {
+        "filter": [{"terms": {"tag": ["red", "green"]}},
+                   {"term": {"body": "epsilon"}}],
+        "must": [{"match": {"body": {"query": "zeta", "operator": "and"}}}]}}},
+    {"query": {"match_phrase": {"body": "alpha beta"}}},
+    {"query": {"match_phrase": {"body": {"query": "alpha gamma", "slop": 2}}}},
+    {"query": {"bool": {
+        "must": [{"match_phrase": {"body": "beta gamma"}}],
+        "filter": [{"term": {"tag": "green"}}]}}},
+    {"query": {"match": {"body": "theta iota"}}, "from": 5, "size": 10},
+    {"query": {"match": {"body": "kappa"}}, "track_total_hits": 20},
+    {"query": {"match": {"body": "mu nu xi"}}, "track_total_hits": True},
+    {"query": {"bool": {"should": [{"match": {"body": "omicron"}},
+                                   {"term": {"body": "pi"}}]}}},
+    # pure-should bool in FILTER context = required single-field OR-group
+    {"query": {"bool": {
+        "must": [{"match": {"body": {"query": "alpha", "operator": "and"}}}],
+        "filter": [{"bool": {"should": [{"term": {"tag": "red"}},
+                                        {"term": {"tag": "green"}}]}}]}}},
+    # bool with required clauses + optional should inside filter ctx:
+    # the should is a non-scoring no-op
+    {"query": {"bool": {
+        "filter": [{"bool": {"must": [{"term": {"body": "beta"}}],
+                             "should": [{"term": {"tag": "red"}}]}}],
+        "must": [{"term": {"body": "gamma"}}]}}},
+]
+
+INELIGIBLE = [
+    {"query": {"match": {"body": "alpha"}}, "sort": [{"n": "asc"}]},
+    {"query": {"match": {"body": "alpha"}},
+     "aggs": {"m": {"max": {"field": "n"}}}},
+    {"query": {"range": {"n": {"gte": 10}}}},
+    {"query": {"bool": {"should": [{"match": {"body": "alpha"}}],
+                        "minimum_should_match": 2}}},
+    {"query": {"match_all": {}}},
+    {"query": {"wildcard": {"body": {"value": "alp*"}}}},
+    # pure-should bool under must is a required SCORED or-group: dense only
+    {"query": {"bool": {
+        "must": [{"bool": {"should": [{"term": {"body": "beta"}},
+                                      {"term": {"body": "gamma"}}]}},
+                 {"term": {"body": "alpha"}}]}}},
+    # multi-alternative top should with a conjunctive alternative
+    {"query": {"bool": {"should": [
+        {"match": {"body": {"query": "alpha beta", "operator": "and"}}},
+        {"term": {"body": "gamma"}}]}}},
+]
+
+
+def _hit_key(h):
+    return h["_id"]
+
+
+def assert_same_results(fast, dense, body):
+    fh = fast["hits"]["hits"]
+    dh = dense["hits"]["hits"]
+    assert [h["_id"] for h in fh] == [h["_id"] for h in dh], body
+    for a, b in zip(fh, dh):
+        if a.get("_score") is not None and b.get("_score") is not None:
+            assert abs(a["_score"] - b["_score"]) <= 2e-4 * abs(b["_score"]) + 2e-4, body
+        assert a["_source"] == b["_source"]
+    assert fast["hits"]["total"] == dense["hits"]["total"], body
+    fm, dm = fast["hits"]["max_score"], dense["hits"]["max_score"]
+    if fm is None or dm is None:
+        assert fm == dm, body
+    else:
+        assert abs(fm - dm) <= 2e-4 * abs(dm) + 2e-4
+
+
+@pytest.mark.parametrize("body", BODIES)
+def test_fast_path_matches_dense(svc, body):
+    plan = extract_plan(body, svc.mapper)
+    assert plan is not None, f"expected eligible: {body}"
+    fast = svc.serving.try_search(body, "query_then_fetch")
+    assert fast is not None, f"fast path did not engage: {body}"
+    dense = svc._search_dense(body)
+    assert_same_results(fast, dense, body)
+
+
+@pytest.mark.parametrize("body", INELIGIBLE)
+def test_ineligible_bodies_fall_back(svc, body):
+    assert extract_plan(body, svc.mapper) is None, body
+    # and the public entry still answers via the dense path
+    r = svc.search(body)
+    assert "hits" in r
+
+
+def test_msearch_batches_match_individual(svc):
+    bodies = [
+        {"query": {"match": {"body": "alpha"}}},
+        {"query": {"match": {"body": "beta gamma"}}},
+        {"query": {"range": {"n": {"gte": 50}}}},        # dense fallback
+        {"query": {"bool": {"must": [{"term": {"body": "delta"}}],
+                            "filter": [{"term": {"tag": "red"}}]}}},
+    ]
+    batch = svc.msearch(bodies)
+    for body, br in zip(bodies, batch):
+        single = svc._search_dense(body)
+        assert_same_results(br, single, body)
+
+
+def test_random_disjunctions_match(svc):
+    rng = np.random.default_rng(7)
+    for _ in range(25):
+        n = int(rng.integers(1, 4))
+        terms = rng.choice(WORDS, size=n, replace=False)
+        body = {"query": {"match": {"body": " ".join(terms)}},
+                "size": int(rng.integers(1, 30))}
+        fast = svc.serving.try_search(body, "query_then_fetch")
+        assert fast is not None
+        assert_same_results(fast, svc._search_dense(body), body)
+
+
+def test_track_total_hits_false_omits_total_on_both_paths(svc):
+    body = {"query": {"match": {"body": "alpha"}}, "track_total_hits": False}
+    fast = svc.serving.try_search(body, "query_then_fetch")
+    dense = svc._search_dense(body)
+    assert "total" not in fast["hits"] and "total" not in dense["hits"]
+    assert [h["_id"] for h in fast["hits"]["hits"]] == \
+        [h["_id"] for h in dense["hits"]["hits"]]
+
+
+def test_msearch_isolates_per_body_errors(svc):
+    from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+    bodies = [
+        {"query": {"match": {"body": "alpha"}}},
+        {"query": {"no_such_query": {}}},
+        {"query": {"term": {"body": "beta"}}},
+    ]
+    out = svc.msearch(bodies)
+    assert "hits" in out[0] and "hits" in out[2]
+    assert isinstance(out[1], ElasticsearchTpuError)
+
+
+def test_multi_shard_defaults_to_dense_but_dfs_serves():
+    meta = IndexMetadata(
+        index="m", uuid="u2",
+        settings=Settings({"index.number_of_shards": 2}),
+        mappings={"properties": {"body": {"type": "text"}}})
+    svc = IndexService(meta)
+    for i in range(100):
+        svc.index_doc(str(i), {"body": f"alpha {WORDS[i % len(WORDS)]}"})
+    svc.refresh()
+    body = {"query": {"match": {"body": "alpha beta"}}}
+    assert svc.serving.try_search(body, "query_then_fetch") is None
+    fast = svc.serving.try_search(body, "dfs_query_then_fetch")
+    assert fast is not None
+    dense = svc._search_dense(body, "dfs_query_then_fetch")
+    assert_same_results(fast, dense, body)
+    svc.close()
