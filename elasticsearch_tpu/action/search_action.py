@@ -66,11 +66,19 @@ class SearchActionService:
             searcher, inst.mapper, p["body"])
         ctx = self.contexts.create(searcher, inst.mapper, p["index"],
                                    p["shard_id"])
-        hits_wire = [{"leaf_idx": h.leaf_idx, "ord": h.ord,
-                      "score": _py(h.score), "global_ord": h.global_ord,
-                      "sort_values": [_py(v) for v in h.sort_values]
-                      if h.sort_values is not None else None}
-                     for h in qr.hits]
+        collapse_field = (p["body"].get("collapse") or {}).get("field")
+        hits_wire = []
+        for h in qr.hits:
+            wh = {"leaf_idx": h.leaf_idx, "ord": h.ord,
+                  "score": _py(h.score), "global_ord": h.global_ord,
+                  "sort_values": [_py(v) for v in h.sort_values]
+                  if h.sort_values is not None else None}
+            if collapse_field:
+                from elasticsearch_tpu.search.query_phase import collapse_value
+
+                wh["collapse"] = _py(collapse_value(
+                    searcher.views[h.leaf_idx].segment, h.ord, collapse_field))
+            hits_wire.append(wh)
         aggs_b64 = None
         if qr.aggregations is not None:
             aggs_b64 = base64.b64encode(
@@ -87,7 +95,7 @@ class SearchActionService:
                          sort_values=h.get("sort_values"))
                 for h in p["hits"]]
         fetched = execute_fetch_phase(ctx.searcher, hits, p["body"],
-                                      ctx.index)
+                                      ctx.index, mapper=ctx.mapper)
         return {"hits": fetched}
 
     def _on_free_context(self, req) -> dict:
@@ -153,10 +161,25 @@ class SearchActionService:
             merged.sort(key=lambda t: _sort_key(
                 ShardHit(t[1]["leaf_idx"], t[1]["ord"], t[1]["score"],
                          t[1]["global_ord"], t[1]["sort_values"]), sort)
-                + (t[0],))
+                + (t[0], t[1]["global_ord"]))
         else:
             merged.sort(key=lambda t: (-t[1]["score"], t[0],
                                        t[1]["global_ord"]))
+        collapse_field = (body.get("collapse") or {}).get("field")
+        if collapse_field:
+            # coordinator-level group dedup (shards collapsed locally; the
+            # same key can still appear on several shards)
+            seen_groups = set()
+            deduped = []
+            for t in merged:
+                v = t[1].get("collapse")
+                if v is not None:
+                    key = (type(v).__name__, v)
+                    if key in seen_groups:
+                        continue
+                    seen_groups.add(key)
+                deduped.append(t)
+            merged = deduped
         window = merged[from_: from_ + size]
 
         max_score = None
@@ -186,6 +209,8 @@ class SearchActionService:
                 continue
             if out.get("_score") is None and h.get("sort_values") is None:
                 out["_score"] = h["score"]
+            if collapse_field:
+                out.setdefault("fields", {})[collapse_field] = [h.get("collapse")]
             hits_out.append(out)
 
         # ---- aggregations: partial reduce then finalize (ref P6) ----
@@ -215,8 +240,9 @@ class SearchActionService:
             "hits": {"total": {"value": total, "relation": relation},
                      "max_score": max_score, "hits": hits_out},
         }
-        if body.get("track_total_hits") is False:
-            resp["hits"].pop("total")   # ref: ES omits total when untracked
+        from elasticsearch_tpu.search.response import finalize_hits_envelope
+
+        finalize_hits_envelope(resp, body)
         if aggs_out is not None:
             resp["aggregations"] = aggs_out
         return resp

@@ -89,11 +89,13 @@ class IndexService:
 
     # ---- search (scatter-gather across shards) ----
 
-    def search(self, request: dict, search_type: str = "query_then_fetch") -> dict:
-        fast = self.serving.try_search(request, search_type)
-        if fast is not None:
-            return fast
-        return self._search_dense(request, search_type)
+    def search(self, request: dict, search_type: str = "query_then_fetch",
+               searchers=None) -> dict:
+        if searchers is None:
+            fast = self.serving.try_search(request, search_type)
+            if fast is not None:
+                return fast
+        return self._search_dense(request, search_type, searchers=searchers)
 
     def msearch(self, requests: List[dict],
                 search_type: str = "query_then_fetch") -> List[dict]:
@@ -118,13 +120,15 @@ class IndexService:
                 results.append(e)
         return results
 
-    def _search_dense(self, request: dict, search_type: str = "query_then_fetch") -> dict:
+    def _search_dense(self, request: dict, search_type: str = "query_then_fetch",
+                      searchers=None) -> dict:
         import time as _time
 
         from elasticsearch_tpu.search.query_phase import QuerySearchResult, _sort_key, parse_sort
 
         start = _time.monotonic()
-        searchers = [s.acquire_searcher() for s in self.shards]
+        if searchers is None:
+            searchers = [s.acquire_searcher() for s in self.shards]
 
         global_stats = None
         if search_type == "dfs_query_then_fetch":
@@ -133,6 +137,15 @@ class IndexService:
 
         size = int(request.get("size", 10))
         from_ = int(request.get("from", 0))
+        collapse_field = (request.get("collapse") or {}).get("field")
+        score_sort_injected = False
+        if (request.get("search_after") is not None or collapse_field
+                or request.get("_want_cursor") or "_after_full" in request) \
+                and not request.get("sort"):
+            # cursor/collapse mechanics need an explicit order; default to
+            # score with the canonical (shard, ord) tiebreak
+            request = {**request, "sort": [{"_score": "desc"}]}
+            score_sort_injected = True
         sort = parse_sort(request.get("sort"))
 
         shard_results: List[QuerySearchResult] = []
@@ -141,7 +154,9 @@ class IndexService:
             ex = None
             if global_stats is not None:
                 ex = QueryExecutor(self.mapper, global_stats)
-            qr = execute_query_phase(searcher, self.mapper, request, executor=ex)
+            shard_req = request if "_after_full" not in request else \
+                {**request, "_shard_id": shard_id}
+            qr = execute_query_phase(searcher, self.mapper, shard_req, executor=ex)
             shard_results.append(qr)
             for h in qr.hits:
                 per_shard_hits.append((shard_id, h))
@@ -149,9 +164,18 @@ class IndexService:
         total = sum(r.total for r in shard_results)
         relation = "gte" if any(r.relation == "gte" for r in shard_results) else "eq"
         if sort:
-            per_shard_hits.sort(key=lambda t: _sort_key(t[1], sort))
+            per_shard_hits.sort(
+                key=lambda t: (_sort_key(t[1], sort), t[0], t[1].global_ord))
         else:
             per_shard_hits.sort(key=lambda t: (-t[1].score, t[0], t[1].global_ord))
+        if collapse_field:
+            from elasticsearch_tpu.search.query_phase import _collapse_ranked, collapse_value
+
+            ranked = [((sid, h),
+                       collapse_value(searchers[sid].views[h.leaf_idx].segment,
+                                      h.ord, collapse_field))
+                      for sid, h in per_shard_hits]
+            per_shard_hits = _collapse_ranked(ranked, from_ + size)
         window = per_shard_hits[from_: from_ + size]
 
         max_score = None
@@ -161,12 +185,27 @@ class IndexService:
                 max_score = max(ms)
 
         hits = []
+        cursor = None
         for shard_id, h in window:
-            fetched = execute_fetch_phase(searchers[shard_id], [h], request, self.name)
+            fetched = execute_fetch_phase(searchers[shard_id], [h], request,
+                                          self.name, mapper=self.mapper)
             hit = fetched[0]
             if hit.get("_score") is None and h.sort_values is None:
                 hit["_score"] = h.score
+            if score_sort_injected:
+                # the sort was internal plumbing: restore plain score hits
+                hit["_score"] = h.score
+                hit.pop("sort", None)
+            if collapse_field:
+                hit.setdefault("fields", {})[collapse_field] = [
+                    collapse_value(searchers[shard_id].views[h.leaf_idx].segment,
+                                   h.ord, collapse_field)]
             hits.append(hit)
+        if window and request.get("_want_cursor"):
+            sid, last = window[-1]
+            cursor = {"values": [s.s if hasattr(s, "s") else s
+                                 for s in (last.sort_values or [])],
+                      "shard_id": sid, "ord": last.global_ord}
 
         aggs = _merge_shard_aggs(request, shard_results)
         took = int((_time.monotonic() - start) * 1000)
@@ -181,10 +220,48 @@ class IndexService:
                 "hits": hits,
             },
         }
-        if request.get("track_total_hits") is False:
-            resp["hits"].pop("total")   # ref: ES omits total when untracked
+        from elasticsearch_tpu.search.response import finalize_hits_envelope
+
+        finalize_hits_envelope(resp, request)
         if aggs is not None:
             resp["aggregations"] = aggs
+        if cursor is not None:
+            resp["_cursor"] = cursor
+        return resp
+
+    # ---- scroll (ref: RestSearchScrollAction + SearchService scroll
+    #      continuation over a pinned reader context) ----
+
+    def scroll_start(self, request: dict, keep_alive_s: float, registry) -> dict:
+        searchers = [s.acquire_searcher() for s in self.shards]
+        ctx = registry.create(searchers=searchers, mapper=self.mapper,
+                              index=self.name, keep_alive_s=keep_alive_s)
+        body = {k: v for k, v in request.items() if k != "scroll"}
+        resp = self._search_dense({**body, "_want_cursor": True},
+                                  searchers=searchers)
+        cursor = resp.pop("_cursor", None)
+        ctx.scroll_state = {"request": body, "cursor": cursor}
+        resp["_scroll_id"] = ctx.context_id
+        return resp
+
+    def scroll_continue(self, ctx) -> dict:
+        state = ctx.scroll_state or {}
+        body = dict(state.get("request") or {})
+        cursor = state.get("cursor")
+        if cursor is None or not cursor.get("values"):
+            resp = self._search_dense({**body, "size": 0},
+                                      searchers=ctx.extra["searchers"])
+            resp["_scroll_id"] = ctx.context_id
+            resp["hits"]["hits"] = []
+            return resp
+        body["_after_full"] = cursor
+        body["_want_cursor"] = True
+        body.pop("from", None)
+        resp = self._search_dense(body, searchers=ctx.extra["searchers"])
+        new_cursor = resp.pop("_cursor", None)
+        ctx.scroll_state = {"request": state.get("request"),
+                            "cursor": new_cursor or {"values": []}}
+        resp["_scroll_id"] = ctx.context_id
         return resp
 
     def stats(self) -> dict:
@@ -218,13 +295,68 @@ def _analyzer_config(meta: IndexMetadata) -> dict:
         return {}
 
 
+def parse_keep_alive(value, default_s: float = 300.0) -> float:
+    """'30s' / '1m' / '2h' / milliseconds int -> seconds."""
+    if value is None:
+        return default_s
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip().lower()
+    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+    for suffix in ("ms", "s", "m", "h", "d"):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * units[suffix]
+    return float(s)
+
+
 class IndicesService:
     """Node-level index registry (ref: indices/IndicesService.java:168)."""
 
     def __init__(self, data_path: Optional[str] = None):
+        from elasticsearch_tpu.search.reader_context import ReaderContextRegistry
+
         self.data_path = data_path
         self._indices: Dict[str, IndexService] = {}
         self._lock = threading.Lock()
+        # PIT/scroll contexts + keepalive reaper (ref: SearchService.Reaper)
+        self.contexts = ReaderContextRegistry()
+        self._reaper_stop = threading.Event()
+        self._reaper: Optional[threading.Thread] = None
+
+    def _ensure_reaper(self) -> None:
+        with self._lock:
+            if self._reaper is None or not self._reaper.is_alive():
+                def loop():
+                    while not self._reaper_stop.wait(5.0):
+                        self.contexts.reap()
+
+                self._reaper = threading.Thread(
+                    target=loop, name="context-reaper", daemon=True)
+                self._reaper.start()
+
+    # ---- point-in-time (ref: RestOpenPointInTimeAction,
+    #      SearchService.openReaderContext) ----
+
+    def open_pit(self, index: str, keep_alive_s: float) -> str:
+        svc = self.get(index)
+        searchers = [s.acquire_searcher() for s in svc.shards]
+        ctx = self.contexts.create(searchers=searchers, mapper=svc.mapper,
+                                   index=index, keep_alive_s=keep_alive_s)
+        self._ensure_reaper()
+        return ctx.context_id
+
+    def close_pit(self, pit_id: str) -> bool:
+        return self.contexts.release(pit_id)
+
+    def scroll_start(self, index: str, request: dict, keep_alive_s: float) -> dict:
+        self._ensure_reaper()
+        return self.get(index).scroll_start(request, keep_alive_s, self.contexts)
+
+    def scroll_continue(self, scroll_id: str, keep_alive_s: Optional[float] = None) -> dict:
+        ctx = self.contexts.get(scroll_id)
+        if keep_alive_s:
+            ctx.keep_alive_s = keep_alive_s
+        return self.get(ctx.index).scroll_continue(ctx)
 
     def create_index(self, name: str, settings: Settings, mappings: dict,
                      aliases: Dict[str, dict] | None = None) -> IndexMetadata:
@@ -265,5 +397,6 @@ class IndicesService:
         return sorted(self._indices)
 
     def close(self) -> None:
+        self._reaper_stop.set()
         for svc in self._indices.values():
             svc.close()

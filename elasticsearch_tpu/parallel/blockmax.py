@@ -310,7 +310,14 @@ class BlockMaxBM25:
         frequency terms keeping thousands of blocks) rides a small dispatch
         with a few peers instead of inflating every light query's padding.
 
-        Returns per batch: (scores [Q,k], shard [Q,k], ord [Q,k])."""
+        Returns per batch: (scores [Q,k], shard [Q,k], ord [Q,k]).
+        Wall-clock per phase lands in self.last_timing (seconds)."""
+        import time as _time
+
+        timing = {"assemble_a": 0.0, "theta_fetch": 0.0, "select": 0.0,
+                  "assemble_b": 0.0, "dispatch_b": 0.0, "result_fetch": 0.0,
+                  "overflow": 0.0, "n_queries": 0, "n_overflow": 0}
+        self.last_timing = timing
         dp = self.mesh.shape.get("dp", 1)
         flat: List[List[Tuple[str, float]]] = []   # all queries, all batches
         spans = []                                 # (batch_idx, start, n)
@@ -329,7 +336,9 @@ class BlockMaxBM25:
         if not flat:
             return []
 
+        timing["n_queries"] = len(flat)
         # ---- pass A: fixed small shape, chunked in order ----
+        t0 = _time.monotonic()
         qa_b, qa_qc = _GROUP_SHAPES[0][0], _GROUP_SHAPES[0][1]
         a_packed = []
         for off in range(0, len(flat), qa_qc):
@@ -342,12 +351,17 @@ class BlockMaxBM25:
                 self.stacked.live, self.hot_cols,
                 jnp.asarray(W), jnp.asarray(qb), jnp.asarray(qi_),
                 mesh=self.mesh, k=k))
+        t1 = _time.monotonic()
+        timing["assemble_a"] = t1 - t0
         # one transfer: theta for every query
         thetas = np.asarray(jnp.concatenate(
             [p[:, 0, k - 1] for p in a_packed]))[: len(flat)]
+        t2 = _time.monotonic()
+        timing["theta_fetch"] = t2 - t1
 
         # ---- selection, then global grouping by bucket ----
         selections, _ = self._select(flat, thetas)
+        timing["select"] = _time.monotonic() - t2
         totals = np.zeros(len(flat), np.int64)
         for qi, terms in enumerate(flat):
             per_shard = np.zeros(max(self.S, 1), np.int64)
@@ -374,6 +388,7 @@ class BlockMaxBM25:
             else:
                 groups.setdefault(_group_shape(int(tot)), []).append(qi)
 
+        t3 = _time.monotonic()
         pending = []   # (query_indices, packed)
         for (bucket, qc), members in sorted(groups.items()):
             qc = max(qc, dp)
@@ -393,6 +408,8 @@ class BlockMaxBM25:
                     jnp.asarray(W), jnp.asarray(qb), jnp.asarray(qi_),
                     mesh=self.mesh, k=k)
                 pending.append((idxs, packed_b))
+        t4 = _time.monotonic()
+        timing["assemble_b"] = timing["dispatch_b"] = t4 - t3
 
         # one transfer: all groups' packed results (flattened; ragged shapes)
         out_all = np.zeros((len(flat), 3, k), np.float32)
@@ -405,8 +422,12 @@ class BlockMaxBM25:
                 grp_out = flat_out[row: row + n_rows].reshape(n_rows, 3, k)
                 row += n_rows
                 out_all[idxs] = grp_out[: len(idxs)]
+        t5 = _time.monotonic()
+        timing["result_fetch"] = t5 - t4
+        timing["n_overflow"] = len(overflow)
         for qi in overflow:
             out_all[qi] = self._exhaustive_topk(flat[qi], selections[qi], k)
+        timing["overflow"] = _time.monotonic() - t5
 
         results = []
         for bi, start, n in spans:
@@ -474,6 +495,145 @@ class BlockMaxBM25:
         packed = _acc_topk(acc, self.hot_cols, self.stacked.live,
                            jnp.asarray(W), mesh=self.mesh, k=k)
         return np.asarray(packed)[0]
+
+    def search_bool(self, queries: Sequence[dict], k: int = 10):
+        """Batched exact `bool` top-k on device (BASELINE config 2 — the
+        reference's WAND/conjunction path, ref: Lucene BooleanWeight +
+        MinShouldMatchSumScorer driven through BlockMaxConjunctionScorer).
+
+        Each query is {"must": [(term, boost)...], "should": [...],
+        "filter": [terms...]}: a hit must contain EVERY must and filter
+        term; its score sums the BM25 contributions of the matching must +
+        should terms (filters score 0). TPU-native execution: all terms'
+        blocks dispatch in one fixed-shape program; per-lane must-flags are
+        segment-summed per doc alongside the scores, so coverage==n_required
+        is one vector compare — no doc-at-a-time conjunction walking. Hot
+        terms contribute through the dense column matmul, with a presence
+        matmul (Wp @ (col>0)) supplying their coverage counts.
+
+        Returns (scores [Q,k], shard [Q,k], ord [Q,k]), doc-id tie-break."""
+        Q = len(queries)
+        out = np.zeros((Q, 3, k), np.float32)
+        specs = []
+        totals = np.zeros(Q, np.int64)
+        for qi_, spec in enumerate(queries):
+            must = [(t, b, True) for t, b in spec.get("must", ())]
+            must += [(t, 0.0, True) for t in spec.get("filter", ())]
+            should = [(t, b, False) for t, b in spec.get("should", ())]
+            rows = []
+            nm = 0
+            per_shard = np.zeros(max(self.S, 1), np.int64)
+            for t, b, required in must + should:
+                m = self._term_meta(t)
+                if required:
+                    nm += 1
+                if m is None:
+                    continue
+                rows.append((t, b, required, m))
+                if m.hot_slot < 0:
+                    for s in range(self.S):
+                        per_shard[s] += len(m.blocks[s].ids)
+            specs.append((rows, nm))
+            totals[qi_] = per_shard.max()
+
+        groups: Dict[Tuple[int, int], List[int]] = {}
+        overflow: List[int] = []
+        for qi_, tot in enumerate(totals):
+            if int(tot) > _MAX_BUCKET:
+                overflow.append(qi_)
+            else:
+                groups.setdefault(_group_shape(int(tot)), []).append(qi_)
+        for qi_ in overflow:
+            out[qi_] = self._bool_exhaustive(*specs[qi_], k)
+        for (bucket, qc), members in sorted(groups.items()):
+            qc = max(qc, self.mesh.shape.get("dp", 1))
+            for off in range(0, len(members), qc):
+                grp = members[off: off + qc]
+                pad = qc - len(grp)
+                use = grp + [grp[-1]] * pad
+                W = np.zeros((qc, self.n_hot_slots), np.float32)
+                Wp = np.zeros((qc, self.n_hot_slots), np.float32)
+                nm_arr = np.zeros(qc, np.float32)
+                qb = np.zeros((qc, self.S, bucket), np.int32)
+                qi = np.zeros((qc, self.S, bucket), np.float32)
+                qf = np.zeros((qc, self.S, bucket), np.float32)
+                for row_i, qx in enumerate(use):
+                    rows, nm = specs[qx]
+                    nm_arr[row_i] = nm
+                    offs = [0] * self.S
+                    for t, b, required, m in rows:
+                        w = m.idf * b
+                        if m.hot_slot >= 0:
+                            W[row_i, m.hot_slot] += w
+                            if required:
+                                # += : a term required twice (must + filter)
+                                # must contribute 2 toward coverage == nm
+                                Wp[row_i, m.hot_slot] += 1.0
+                            continue
+                        for s in range(self.S):
+                            sb = m.blocks[s]
+                            n = len(sb.ids)
+                            if not n:
+                                continue
+                            qb[row_i, s, offs[s]: offs[s] + n] = sb.ids
+                            qi[row_i, s, offs[s]: offs[s] + n] = w
+                            if required:
+                                qf[row_i, s, offs[s]: offs[s] + n] = 1.0
+                            offs[s] += n
+                packed = _bool_program(
+                    self.stacked.block_docs, self.stacked.block_scores,
+                    self.stacked.live, self.hot_cols,
+                    jnp.asarray(W), jnp.asarray(Wp), jnp.asarray(qb),
+                    jnp.asarray(qi), jnp.asarray(qf), jnp.asarray(nm_arr),
+                    mesh=self.mesh, k=k)
+                out[grp] = np.asarray(packed)[: len(grp)]
+        return out[:, 0], out[:, 1].view(np.int32), out[:, 2].view(np.int32)
+
+    def _bool_exhaustive(self, rows, nm: int, k: int) -> np.ndarray:
+        """Host fallback for block-heavy bool queries (> _MAX_BUCKET blocks
+        per shard): dense [D] score+coverage accumulators per shard via
+        bincount — exact for any block count. Returns packed [3, k]."""
+        hot_np = None
+        cand: List[Tuple[float, int, int]] = []
+        for s in range(self.S):
+            scores = np.zeros(self.D, np.float32)
+            cover = np.zeros(self.D, np.int32)
+            fp = self.stacked.postings[s]
+            bs = _host_block_scores(fp, self.stacked.avgdl)
+            for t, b, required, m in rows:
+                w = m.idf * b
+                if m.hot_slot >= 0:
+                    if hot_np is None:
+                        hot_np = np.asarray(self.hot_cols)
+                    col = hot_np[s, m.hot_slot]
+                    scores += (w * col).astype(np.float32)
+                    if required:
+                        cover += (col > 0)
+                    continue
+                sb = m.blocks[s]
+                if not len(sb.ids):
+                    continue
+                docs = fp.block_docs[sb.ids].ravel()
+                vals = bs[sb.ids].ravel()
+                nz = vals > 0
+                scores += np.bincount(docs[nz], weights=w * vals[nz],
+                                      minlength=self.D).astype(np.float32)
+                if required:
+                    cover[docs[nz]] += 1
+            live = np.asarray(self.stacked.live[s])
+            ok = (cover == nm) & live[: self.D] & (scores > 0)
+            docs = np.nonzero(ok)[0]
+            if len(docs):
+                sel = np.lexsort((docs, -scores[docs]))[:k]
+                cand.extend((float(scores[docs[i]]), s, int(docs[i]))
+                            for i in sel)
+        cand.sort(key=lambda x: (-x[0], x[1], x[2]))
+        packed = np.zeros((3, k), np.float32)
+        for j, (sc, s, d) in enumerate(cand[:k]):
+            packed[0, j] = sc
+            packed[1, j] = np.int32(s).view(np.float32)
+            packed[2, j] = np.int32(d).view(np.float32)
+        return packed
 
     def search_phrase(self, phrases: Sequence[List[str]], k: int = 10,
                       slop: int = 0,
@@ -652,6 +812,97 @@ def _acc_topk(acc, hot_cols, live, W, *, mesh, k):
              jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
 
     return program(acc, hot_cols, live, W)
+
+
+def _one_query_topk_bool(d, s, c, dense, hp, live, nm, k):
+    """Exact bool top-k for one query on one partition.
+
+    d/s as in _one_query_topk; c [L] per-lane must-flags (1.0 where the lane
+    belongs to a required term and is a real posting), dense [D] hot-term
+    scores, hp [D] hot-term must-presence counts, nm scalar required count.
+    A doc qualifies iff its summed must-flags + hot presences == nm."""
+    order = jnp.argsort(d)
+    d = jnp.take(d, order)
+    s = jnp.take(s, order)
+    c = jnp.take(c, order)
+    tot = _segmented_run_sums(d, s)
+    cnt = _segmented_run_sums(d, c)
+    is_last = jnp.concatenate([d[1:] != d[:-1], jnp.ones(1, bool)])
+    lane_tot = tot + jnp.take(dense, d)
+    lane_cov = cnt + jnp.take(hp, d)
+    # NOTE: no (tot > 0) gate — a doc can qualify through weight-0 filter
+    # lanes with its entire score coming from hot columns (lane_tot > 0
+    # still excludes score-0 docs and the zero-block padding run on doc 0,
+    # whose cf lanes are 0 so it cannot fake coverage)
+    ok = (is_last & jnp.take(live, d)
+          & (jnp.abs(lane_cov - nm) < 0.5) & (lane_tot > 0))
+    neg2, cand2_d = jax.lax.sort(
+        (-jnp.where(ok, lane_tot, -jnp.inf), d), num_keys=2)
+    cand2_s, cand2_d = -neg2[:k], cand2_d[:k]
+    # dense-only candidates: all required terms hot-present, positive score
+    ok1 = live & (dense > 0) & (jnp.abs(hp - nm) < 0.5)
+    cand1_s, cand1_d = _dense_topk_tiebreak(
+        jnp.where(ok1, dense, -jnp.inf), k)
+    ms = jnp.concatenate([cand1_s, cand2_s])
+    md = jnp.concatenate([cand1_d.astype(jnp.int32), cand2_d])
+    md2, neg_ms2 = jax.lax.sort((md, -ms), num_keys=2)
+    ms2 = -neg_ms2
+    first = jnp.concatenate([jnp.ones(1, bool), md2[1:] != md2[:-1]])
+    final = jnp.where(first & (ms2 > -jnp.inf), ms2, -jnp.inf)
+    neg_f, md3 = jax.lax.sort((-final, md2), num_keys=2)
+    return -neg_f[:k], md3[:k]
+
+
+@partial(jax.jit, static_argnames=("mesh", "k"))
+def _bool_program(block_docs, block_scores, live, hot_cols, W, Wp, qb, qi, qf,
+                  nm, *, mesh, k):
+    """Exact bool (conjunction + optional scorers) over the mesh.
+
+    Shapes as _hybrid_program plus Wp [Q,H] must-hot masks, qf [Q,S,B]
+    per-block must flags, nm [Q] required-term counts. Output packed
+    [Q,3,k]."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
+                  P("dp"), P("dp"), P("dp", "shard"), P("dp", "shard"),
+                  P("dp", "shard"), P("dp")),
+        out_specs=P("dp"),
+        check_vma=False,
+    )
+    def program(block_docs, block_scores, live, hot_cols, W, Wp, qb, qi, qf, nm):
+        def one_part(bd, bs, lv, hc, qb1, qi1, qf1):
+            dense = jax.lax.dot_general(
+                W, hc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)          # [Qc, D]
+            pres = jax.lax.dot_general(
+                Wp, (hc > 0).astype(jnp.float32), (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)          # [Qc, D]
+            docs = jnp.take(bd, qb1, axis=0)                  # [Qc, B, 128]
+            sc_lane = jnp.take(bs, qb1, axis=0)
+            sc = qi1[:, :, None] * sc_lane
+            cf = qf1[:, :, None] * (sc_lane > 0)              # real postings only
+            Qc = qb1.shape[0]
+            return jax.vmap(
+                lambda dd, ss, cc, dn, pp, n1: _one_query_topk_bool(
+                    dd, ss, cc, dn, pp, lv, n1, k))(
+                docs.reshape(Qc, -1), sc.reshape(Qc, -1), cf.reshape(Qc, -1),
+                dense, pres, nm)
+
+        s_scores, s_ords = jax.vmap(
+            one_part, in_axes=(0, 0, 0, 0, 1, 1, 1))(
+            block_docs, block_scores, live, hot_cols, qb, qi, qf)
+        top_s, shard_of, ord_of = _merge_gathered(
+            _gather_parts(s_scores), _gather_parts(s_ords), k)
+        return jnp.stack(
+            [top_s,
+             jax.lax.bitcast_convert_type(shard_of, jnp.float32),
+             jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
+
+    return program(block_docs, block_scores, live, hot_cols, W, Wp, qb, qi, qf, nm)
 
 
 @partial(jax.jit, static_argnames=("mesh", "k"))

@@ -78,6 +78,11 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("POST", "/{index}/_search", h.search)
     r("GET", "/_search", h.search_all)
     r("POST", "/_search", h.search_all)
+    r("GET", "/_search/scroll", h.scroll_next)
+    r("POST", "/_search/scroll", h.scroll_next)
+    r("DELETE", "/_search/scroll", h.scroll_clear)
+    r("POST", "/{index}/_pit", h.open_pit)
+    r("DELETE", "/_pit", h.close_pit)
     r("POST", "/_msearch", h.msearch)
     r("GET", "/_msearch", h.msearch)
     r("POST", "/{index}/_msearch", h.msearch)
@@ -419,8 +424,21 @@ class _Handlers:
     # ---------- search ----------
 
     def search(self, req: RestRequest) -> RestResponse:
-        names = self._resolve(req.param("index"), require=True)
+        from elasticsearch_tpu.index.index_service import parse_keep_alive
+
         body = dict(req.body or {})
+        # point-in-time searches carry their index inside the pinned context
+        pit = body.get("pit")
+        if pit:
+            ctx = self.node.indices.contexts.get(pit["id"])
+            if pit.get("keep_alive"):
+                ctx.keep_alive_s = parse_keep_alive(pit["keep_alive"])
+            clean = {k: v for k, v in body.items() if k != "pit"}
+            svc = self.node.indices.get(ctx.index)
+            resp = svc.search(clean, searchers=ctx.extra["searchers"])
+            resp["pit_id"] = pit["id"]
+            return _ok(resp)
+        names = self._resolve(req.param("index"), require=True)
         # url params mirror body fields (ref: RestSearchAction)
         if req.param("q") is not None:
             body["query"] = {"match": {"_all": req.param("q")}}  # minimal q= support
@@ -428,9 +446,48 @@ class _Handlers:
             if req.param(p) is not None:
                 body[p] = req.param_int(p)
         search_type = req.param("search_type", "query_then_fetch")
+        if req.param("scroll") is not None:
+            if len(names) != 1:
+                raise IllegalArgumentError("scroll requires a single index")
+            keep = parse_keep_alive(req.param("scroll"))
+            return _ok(self.node.indices.scroll_start(names[0], body, keep))
         if len(names) == 1:
             return _ok(self.node.indices.get(names[0]).search(body, search_type))
         return _ok(self._multi_index_search(names, body, search_type))
+
+    def scroll_next(self, req: RestRequest) -> RestResponse:
+        from elasticsearch_tpu.index.index_service import parse_keep_alive
+
+        body = dict(req.body or {})
+        scroll_id = body.get("scroll_id") or req.param("scroll_id")
+        if not scroll_id:
+            raise IllegalArgumentError("scroll_id is required")
+        keep = parse_keep_alive(body.get("scroll") or req.param("scroll"),
+                                0.0) or None
+        return _ok(self.node.indices.scroll_continue(scroll_id, keep))
+
+    def scroll_clear(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        ids = body.get("scroll_id", [])
+        if isinstance(ids, str):
+            ids = [ids]
+        freed = sum(1 for i in ids if self.node.indices.contexts.release(i))
+        return _ok({"succeeded": True, "num_freed": freed})
+
+    def open_pit(self, req: RestRequest) -> RestResponse:
+        from elasticsearch_tpu.index.index_service import parse_keep_alive
+
+        names = self._resolve(req.param("index"), require=True)
+        if len(names) != 1:
+            raise IllegalArgumentError("PIT requires a single index")
+        keep = parse_keep_alive(req.param("keep_alive"))
+        pit_id = self.node.indices.open_pit(names[0], keep)
+        return _ok({"id": pit_id})
+
+    def close_pit(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        ok = self.node.indices.close_pit(body.get("id", ""))
+        return _ok({"succeeded": ok, "num_freed": int(ok)})
 
     def search_all(self, req: RestRequest) -> RestResponse:
         req.params.setdefault("index", "_all")

@@ -76,9 +76,16 @@ def execute_fetch_phase(
     hits: List[ShardHit],
     request: dict,
     index_name: str,
+    mapper=None,
 ) -> List[dict]:
     source_spec = request.get("_source")
     fields_spec = request.get("fields")
+    highlight_spec = request.get("highlight")
+    hl_query = None
+    if highlight_spec and mapper is not None and request.get("query"):
+        from elasticsearch_tpu.search.queries import parse_query
+
+        hl_query = parse_query(request["query"])
     out = []
     for h in hits:
         seg = searcher.views[h.leaf_idx].segment
@@ -94,6 +101,12 @@ def execute_fetch_phase(
             hit["fields"] = _fetch_fields(seg, h.ord, fields_spec)
         if h.sort_values is not None:
             hit["sort"] = [s.s if hasattr(s, "s") else s for s in h.sort_values]
+        if hl_query is not None:
+            from elasticsearch_tpu.search.highlight import highlight_hit
+
+            hl = highlight_hit(seg, h.ord, highlight_spec, hl_query, mapper)
+            if hl:
+                hit["highlight"] = hl
         out.append(hit)
     return out
 

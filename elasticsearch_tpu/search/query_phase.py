@@ -77,8 +77,27 @@ def execute_query_phase(
     from_ = int(request.get("from", 0))
     min_score = request.get("min_score")
     sort = parse_sort(request.get("sort"))
+    collapse_field = (request.get("collapse") or {}).get("field")
+    if collapse_field and not sort:
+        # collapse needs the full candidate stream per leaf, not a device
+        # top-k: route through sorted collection on score
+        sort = [("_score", "desc")]
     track = request.get("track_total_hits", 10000)
     k = from_ + size
+
+    # pagination cursors (ref: SearchAfterBuilder / scroll continuation)
+    after = None
+    if request.get("search_after") is not None:
+        if not sort:
+            raise IllegalArgumentError("search_after requires a sort")
+        after = (_after_prefix(sort, request["search_after"]), None, 0)
+    full = request.get("_after_full")
+    if full is not None:
+        if not sort:
+            raise IllegalArgumentError("cursor continuation requires a sort")
+        after = (_after_prefix(sort, full["values"]),
+                 (int(full["shard_id"]), int(full["ord"])),
+                 int(request.get("_shard_id", 0)))
 
     if query is None and knn_spec is None:
         query = q.MatchAllQuery()
@@ -148,7 +167,14 @@ def execute_query_phase(
             leaf_masks.append((leaf, np.asarray(mask), np.asarray(scores)))
 
         if sort:
-            collected.extend(_collect_sorted(leaf, leaf_idx, scores, mask, sort, k))
+            leaf_hits = _collect_sorted(leaf, leaf_idx, scores, mask, sort,
+                                        None if collapse_field else k,
+                                        after=after)
+            if collapse_field:
+                # keep the best hit of each of the top-k groups (ref:
+                # CollapsingTopDocsCollector — shards return k GROUPS)
+                leaf_hits = _leaf_collapse(leaf, leaf_hits, collapse_field, k)
+            collected.extend(leaf_hits)
         else:
             kk = min(k, leaf.n_docs)
             if kk == 0:
@@ -162,9 +188,15 @@ def execute_query_phase(
                     collected.append(ShardHit(leaf_idx, int(o), float(s), leaf.base + int(o)))
 
     if sort:
-        keyed = [(_sort_key(h, sort), h) for h in collected]
+        keyed = [((_sort_key(h, sort), h.global_ord), h) for h in collected]
         keyed.sort(key=lambda kv: kv[0])
-        merged = [h for _, h in keyed[:k]]
+        merged = [h for _, h in keyed]
+        if collapse_field:
+            merged = _collapse_ranked(
+                [(h, collapse_value(lvs[h.leaf_idx].segment, h.ord,
+                                    collapse_field)) for h in merged], k)
+        else:
+            merged = merged[:k]
     else:
         collected.sort(key=lambda h: (-h.score, h.global_ord))
         merged = collected[:k]
@@ -207,7 +239,45 @@ def execute_query_phase(
                              max_score=max_score, aggregations=agg_partials)
 
 
-def _collect_sorted(leaf: LeafContext, leaf_idx: int, scores, mask, sort, k) -> List[ShardHit]:
+def collapse_value(seg, ord_: int, field: str):
+    """Single doc-values entry used for field collapsing (ref:
+    search/collapse/CollapseBuilder — keyword or numeric, single-valued)."""
+    kc = seg.keyword.get(field)
+    if kc is not None and kc.exists[ord_]:
+        return kc.terms[kc.ords[ord_]]
+    nc = seg.numeric.get(field)
+    if nc is not None and nc.exists[ord_]:
+        return float(nc.values[ord_])
+    return None
+
+
+def _collapse_ranked(ranked, k):
+    """First (best-ranked) hit per collapse value; None groups pass through
+    uncollapsed (ES: missing values are not grouped together)."""
+    seen = set()
+    out = []
+    for h, v in ranked:
+        if v is not None:
+            if v in seen:
+                continue
+            seen.add(v)
+        out.append(h)
+        if len(out) >= k:
+            break
+    return out
+
+
+def _leaf_collapse(leaf: LeafContext, hits, field: str, k: int):
+    return _collapse_ranked(
+        [(h, collapse_value(leaf.segment, h.ord, field)) for h in hits], k)
+
+
+def _collect_sorted(leaf: LeafContext, leaf_idx: int, scores, mask, sort, k,
+                    after=None) -> List[ShardHit]:
+    """after: optional (prefix_key, shard_key, shard_id) — keep only hits
+    STRICTLY after the cursor in the canonical (sort, shard, ord) order.
+    shard_key is None for user search_after (prefix-only, ties skipped —
+    ES semantics: add a tiebreaker field for gapless pagination)."""
     mask_np = np.asarray(mask)
     cand = np.nonzero(mask_np)[0]
     if len(cand) == 0:
@@ -243,23 +313,48 @@ def _collect_sorted(leaf: LeafContext, leaf_idx: int, scores, mask, sort, k) -> 
         sv = [c[i] for c in sort_cols]
         out.append(ShardHit(leaf_idx, int(ord_), float(scores_np[ord_]),
                             leaf.base + int(ord_), sort_values=sv))
-    # local truncation: sort + cut to k to bound merge cost
-    out.sort(key=lambda h: _sort_key(h, sort))
-    return out[:k]
+    if after is not None:
+        prefix, shard_key, shard_id = after
+        kept = []
+        for h in out:
+            hk = _sort_key(h, sort)
+            if hk > prefix:
+                kept.append(h)
+            elif hk == prefix and shard_key is not None and \
+                    (shard_id, h.global_ord) > shard_key:
+                kept.append(h)
+        out = kept
+    # local truncation: sort + cut to k to bound merge cost (k=None: caller
+    # needs the full stream, e.g. for collapse grouping)
+    out.sort(key=lambda h: (_sort_key(h, sort), h.global_ord))
+    return out if k is None else out[:k]
 
 
 def _sort_key(hit: ShardHit, sort) -> tuple:
+    """Comparable prefix from the hit's sort values — NO tiebreaker; callers
+    append (shard_id, global_ord) as needed so local sort, coordinator merge
+    and cursor comparison all share one canonical total order."""
+    return _key_from_values(hit.sort_values, sort)
+
+
+def _key_from_values(values, sort) -> tuple:
     key = []
-    for (fname, order), v in zip(sort, hit.sort_values):
+    for (fname, order), v in zip(sort, values):
         if fname == "_score":
-            v = -v if order == "desc" else v
-            key.append(v)
+            key.append(-float(v) if order == "desc" else float(v))
         elif isinstance(v, str):
             key.append(_InvStr(v) if order == "desc" else v)
         else:
             key.append(-float(v) if order == "desc" else float(v))
-    key.append(hit.global_ord)
     return tuple(key)
+
+
+def _after_prefix(sort, values) -> tuple:
+    """Build the cursor key for search_after values (client-supplied)."""
+    if len(values) != len(sort):
+        raise IllegalArgumentError(
+            f"search_after must have {len(sort)} value(s) to match the sort")
+    return _key_from_values(list(values), sort)
 
 
 class _InvStr:

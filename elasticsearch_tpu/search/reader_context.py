@@ -49,13 +49,19 @@ class ReaderContextRegistry:
         self.default_keep_alive_s = default_keep_alive_s
         self.max_open_contexts = max_open_contexts
 
-    def create(self, searcher, mapper, index: str, shard_id: int,
-               keep_alive_s: Optional[float] = None) -> ReaderContext:
+    def create(self, searcher=None, mapper=None, index: str = "",
+               shard_id: int = -1, keep_alive_s: Optional[float] = None,
+               searchers=None) -> ReaderContext:
+        """Pin one shard searcher (per-shard query/fetch contexts) or a list
+        of them (`searchers=` — index-wide PIT/scroll contexts; stored in
+        .extra['searchers'])."""
         keep = keep_alive_s or self.default_keep_alive_s
         ctx = ReaderContext(
             context_id=uuid.uuid4().hex, searcher=searcher, mapper=mapper,
             index=index, shard_id=shard_id, keep_alive_s=keep,
             expires_at=time.monotonic() + keep)
+        if searchers is not None:
+            ctx.extra["searchers"] = searchers
         with self._lock:
             if len(self._contexts) >= self.max_open_contexts:
                 raise ElasticsearchTpuError(

@@ -25,7 +25,8 @@ def execute_search(
     qr = execute_query_phase(searcher, mapper, request)
     from_ = int(request.get("from", 0))
     window = qr.hits[from_: from_ + int(request.get("size", 10))]
-    hits = execute_fetch_phase(searcher, window, request, index_name)
+    hits = execute_fetch_phase(searcher, window, request, index_name,
+                               mapper=mapper)
     for h, sh in zip(hits, window):
         if h["_score"] is None and sh.sort_values is None:
             h["_score"] = sh.score
@@ -40,8 +41,9 @@ def execute_search(
             "hits": hits,
         },
     }
-    if request.get("track_total_hits") is False:
-        resp["hits"].pop("total")       # ref: ES omits total when untracked
+    from elasticsearch_tpu.search.response import finalize_hits_envelope
+
+    finalize_hits_envelope(resp, request)
     if qr.aggregations is not None:
         from elasticsearch_tpu.search.aggregations import finalize_shard_aggs
 

@@ -681,6 +681,6 @@ class ServingContext:
                 "hits": out_hits,
             },
         }
-        if request.get("track_total_hits") is False:
-            resp["hits"].pop("total")   # ref: ES omits total when untracked
-        return resp
+        from elasticsearch_tpu.search.response import finalize_hits_envelope
+
+        return finalize_hits_envelope(resp, request)

@@ -189,6 +189,116 @@ def test_fast_postings_builder_matches_slow():
     np.testing.assert_array_equal(fast.doc_len, slow.doc_len)
 
 
+def _brute_bool(segments, stacked, spec, k):
+    """Dense reference: accumulate scores + coverage per shard, filter, rank."""
+    from elasticsearch_tpu.ops import bm25_idf
+    from elasticsearch_tpu.parallel.blockmax import _host_block_scores
+
+    must = [(t, b, True) for t, b in spec.get("must", ())]
+    must += [(t, 0.0, True) for t in spec.get("filter", ())]
+    should = [(t, b, False) for t, b in spec.get("should", ())]
+    nm = sum(1 for _ in must)
+    out = []
+    df_of = {}
+    for t, _, _ in must + should:
+        df_of[t] = sum(int(fp.doc_freq[fp.term_to_ord[t]])
+                       for fp in (s.postings["body"] for s in segments)
+                       if t in fp.term_to_ord)
+    for si, seg in enumerate(segments):
+        fp = seg.postings["body"]
+        bs = _host_block_scores(fp, stacked.avgdl)
+        scores = np.zeros(seg.n_docs, np.float32)
+        cover = np.zeros(seg.n_docs, np.int32)
+        for t, b, req in must + should:
+            if df_of[t] == 0:
+                continue
+            o = fp.term_to_ord.get(t)
+            if o is None:
+                continue
+            w = bm25_idf(stacked.total_docs, df_of[t]) * b
+            lo, hi = int(fp.post_start[o]), int(fp.post_start[o + 1])
+            docs = fp.post_doc[lo:hi]
+            start, cnt = int(fp.block_start[o]), int(fp.block_count[o])
+            lane = bs[start:start + cnt].ravel()
+            ld = fp.block_docs[start:start + cnt].ravel()
+            nz = lane > 0
+            scores[ld[nz]] += (w * lane[nz]).astype(np.float32)
+            if req:
+                cover[docs] += 1
+        ok = (cover == nm) & (scores > 0)
+        docs = np.nonzero(ok)[0]
+        if len(docs):
+            sel = np.lexsort((docs, -scores[docs]))[:k]
+            out.extend((float(scores[docs[i]]), si, int(docs[i])) for i in sel)
+    out.sort(key=lambda x: (-x[0], x[1], x[2]))
+    return out[:k]
+
+
+@pytest.mark.parametrize("n_shards", [1, 2])
+def test_search_bool_matches_brute_force(n_shards):
+    rng = np.random.default_rng(41)
+    segments = zipf_corpus(rng, N_DOCS, n_shards)
+    mesh = make_mesh(n_shards, dp=1)
+    stacked = build_stacked_bm25(segments, "body", mesh=mesh)
+    serving = BlockMaxBM25(stacked, mesh)
+
+    qprobs = 1.0 / np.arange(1, VOCAB + 1) ** 1.07
+    qprobs /= qprobs.sum()
+    queries = []
+    for _ in range(30):
+        terms = [f"t{t}" for t in rng.choice(VOCAB, size=5, p=qprobs,
+                                             replace=False)]
+        queries.append({
+            "must": [(terms[0], 1.0), (terms[1], float(rng.choice([1.0, 2.0])))],
+            "should": [(terms[2], 1.0), (terms[3], 1.0)],
+            "filter": [terms[4]] if rng.random() < 0.5 else [],
+        })
+    # hot-term cases: t0/t1 are stopword-grade under the Zipf draw
+    queries.append({"must": [("t0", 1.0)], "should": [("t5", 1.0)]})
+    queries.append({"must": [("t0", 1.0), ("t1", 1.0)], "filter": ["t2"]})
+    queries.append({"must": [("t200", 1.0)], "filter": ["t0"]})
+    queries.append({"must": [("absent-term", 1.0), ("t1", 1.0)]})
+
+    got_s, got_sh, got_o = serving.search_bool(queries, k=10)
+    for qi_, spec in enumerate(queries):
+        want = _brute_bool(segments, stacked, spec, 10)
+        got = [(float(got_s[qi_][j]), int(got_sh[qi_][j]), int(got_o[qi_][j]))
+               for j in range(10) if got_s[qi_][j] > 0]
+        assert len(got) == len(want), f"query {qi_}: {spec}"
+        for (es, esh, eo), (gs, gsh, go) in zip(want, got):
+            assert abs(es - gs) <= 2e-5 * abs(es) + 2e-5, f"query {qi_}"
+            if abs(es - gs) == 0.0 or True:
+                pass
+        # order equality wherever adjacent scores separated beyond f32 noise
+        ws = np.asarray([w[0] for w in want])
+        gaps = np.abs(np.diff(ws)) > 2e-5 * np.abs(ws[:-1]) + 2e-5
+        if gaps.all():
+            assert [(sh, o) for _, sh, o in want] == \
+                [(sh, o) for _, sh, o in got], f"query {qi_}: {spec}"
+        else:
+            assert {(sh, o) for _, sh, o in want} == \
+                {(sh, o) for _, sh, o in got}, f"query {qi_}: {spec}"
+
+
+def test_search_bool_overflow_fallback(monkeypatch):
+    import elasticsearch_tpu.parallel.blockmax as bm
+
+    rng = np.random.default_rng(43)
+    segments = zipf_corpus(rng, N_DOCS, 1)
+    mesh = make_mesh(1, dp=1)
+    stacked = build_stacked_bm25(segments, "body", mesh=mesh)
+    queries = [{"must": [("t10", 1.0)], "should": [("t20", 1.0)]}
+               for _ in range(3)]
+    want = [_brute_bool(segments, stacked, q, 10) for q in queries]
+    monkeypatch.setattr(bm, "_MAX_BUCKET", 4)
+    serving = BlockMaxBM25(stacked, mesh)
+    got_s, got_sh, got_o = serving.search_bool(queries, k=10)
+    for qi_, w in enumerate(want):
+        got = [(float(got_s[qi_][j]), int(got_sh[qi_][j]), int(got_o[qi_][j]))
+               for j in range(10) if got_s[qi_][j] > 0]
+        assert [(sh, o) for _, sh, o in w] == [(sh, o) for _, sh, o in got]
+
+
 def test_overflow_path_matches_exhaustive(monkeypatch):
     """Queries whose surviving blocks exceed the largest dispatch bucket must
     take the chunked scatter-add overflow path and stay EXACT (ADVICE r2: the

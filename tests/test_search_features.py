@@ -1,0 +1,254 @@
+"""search_after, PIT, scroll, highlight, collapse (VERDICT r2 next #5).
+
+Done-criteria exercised here: stable pagination over many results while
+concurrent indexing continues (PIT/scroll pin their snapshot); phrase
+match highlighting; collapse dedup with best-hit-per-group semantics.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.state import IndexMetadata
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService, IndicesService
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta"]
+
+
+def make_indices(n_docs=500, shards=1):
+    ind = IndicesService()
+    ind.create_index("t", Settings({"index.number_of_shards": shards}), {
+        "properties": {
+            "body": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "rank": {"type": "integer"},
+        }}, {})
+    svc = ind.get("t")
+    rng = np.random.default_rng(11)
+    for i in range(n_docs):
+        words = rng.choice(WORDS, size=int(rng.integers(3, 12)))
+        svc.index_doc(str(i), {"body": " ".join(words),
+                               "tag": f"g{i % 7}", "rank": int(i)})
+        if i == n_docs // 2:
+            svc.refresh()
+    svc.refresh()
+    return ind, svc
+
+
+@pytest.fixture(scope="module")
+def env():
+    ind, svc = make_indices()
+    yield ind, svc
+    ind.close()
+
+
+# ---------------- search_after ----------------
+
+
+def test_search_after_paginates_without_gaps(env):
+    _, svc = env
+    body = {"query": {"match_all": {}}, "size": 50,
+            "sort": [{"rank": "asc"}], "track_total_hits": True}
+    seen = []
+    after = None
+    while True:
+        b = dict(body)
+        if after is not None:
+            b["search_after"] = after
+        r = svc.search(b)
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        seen.extend(h["_id"] for h in hits)
+        after = hits[-1]["sort"]
+    assert seen == [str(i) for i in range(500)]
+
+
+def test_search_after_requires_sort(env):
+    from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+    _, svc = env
+    with pytest.raises(IllegalArgumentError):
+        svc._search_dense({"query": {"match_all": {}},
+                           "sort": [{"rank": "asc"}],
+                           "search_after": [1, 2]})
+
+
+def test_search_after_score_sort(env):
+    _, svc = env
+    base = {"query": {"match": {"body": "alpha beta"}}, "size": 20,
+            "sort": [{"_score": "desc"}, {"rank": "asc"}]}
+    full = svc.search({**base, "size": 60})["hits"]["hits"]
+    page1 = svc.search(base)["hits"]["hits"]
+    page2 = svc.search({**base, "search_after": page1[-1]["sort"]})["hits"]["hits"]
+    got = [h["_id"] for h in page1 + page2]
+    assert got == [h["_id"] for h in full[:40]]
+
+
+# ---------------- scroll ----------------
+
+
+def test_scroll_stable_under_concurrent_indexing(env):
+    ind, svc = env
+    r = ind.scroll_start("t", {"query": {"match_all": {}}, "size": 64,
+                              "sort": [{"rank": "asc"}]}, 60.0)
+    sid = r["_scroll_id"]
+    seen = [h["_id"] for h in r["hits"]["hits"]]
+    step = 0
+    while True:
+        # concurrent writes must not affect the pinned snapshot
+        svc.index_doc(f"new-{step}", {"body": "alpha", "rank": 10_000 + step})
+        if step % 3 == 0:
+            svc.refresh()
+        step += 1
+        r = ind.scroll_continue(sid)
+        if not r["hits"]["hits"]:
+            break
+        seen.extend(h["_id"] for h in r["hits"]["hits"])
+    assert seen == [str(i) for i in range(500)]
+    assert ind.contexts.release(sid)
+
+
+def test_scroll_default_score_order(env):
+    ind, svc = env
+    full = svc.search({"query": {"match": {"body": "gamma"}}, "size": 100,
+                       "track_total_hits": True})
+    r = ind.scroll_start("t", {"query": {"match": {"body": "gamma"}},
+                               "size": 30}, 60.0)
+    sid = r["_scroll_id"]
+    seen = [(h["_id"], h["_score"]) for h in r["hits"]["hits"]]
+    assert all(s is not None for _, s in seen)
+    while True:
+        r = ind.scroll_continue(sid)
+        if not r["hits"]["hits"]:
+            break
+        seen.extend((h["_id"], h["_score"]) for h in r["hits"]["hits"])
+    want = [(h["_id"], h["_score"]) for h in full["hits"]["hits"]]
+    assert [i for i, _ in seen][: len(want)] == [i for i, _ in want]
+    assert len(seen) == full["hits"]["total"]["value"]
+    ind.contexts.release(sid)
+
+
+# ---------------- PIT ----------------
+
+
+def test_pit_pins_snapshot(env):
+    ind, svc = env
+    svc.refresh()   # drain any unrefreshed docs from earlier tests
+    pit = ind.open_pit("t", 60.0)
+    before = svc.search({"query": {"match_all": {}}, "size": 0,
+                         "track_total_hits": True},
+                        searchers=ind.contexts.get(pit).extra["searchers"])
+    n0 = before["hits"]["total"]["value"]
+    for i in range(20):
+        svc.index_doc(f"pit-{i}", {"body": "alpha beta", "rank": 0})
+    svc.refresh()
+    after = svc.search({"query": {"match_all": {}}, "size": 0,
+                        "track_total_hits": True},
+                       searchers=ind.contexts.get(pit).extra["searchers"])
+    assert after["hits"]["total"]["value"] == n0
+    live = svc.search({"query": {"match_all": {}}, "size": 0,
+                       "track_total_hits": True})
+    assert live["hits"]["total"]["value"] == n0 + 20
+    assert ind.close_pit(pit)
+
+
+def test_pit_expiry_reaped(env):
+    ind, _ = env
+    pit = ind.open_pit("t", 0.01)
+    import time
+
+    time.sleep(0.05)
+    assert ind.contexts.reap() >= 1
+    from elasticsearch_tpu.search.reader_context import SearchContextMissingError
+
+    with pytest.raises(SearchContextMissingError):
+        ind.contexts.get(pit)
+
+
+# ---------------- highlight ----------------
+
+
+def test_highlight_terms_and_phrase():
+    ind = IndicesService()
+    ind.create_index("h", Settings({}), {
+        "properties": {"body": {"type": "text"}}}, {})
+    svc = ind.get("h")
+    svc.index_doc("1", {"body": "the quick brown fox jumps over the lazy dog"})
+    svc.index_doc("2", {"body": "a quick study of brown bears"})
+    svc.refresh()
+    r = svc.search({"query": {"match": {"body": "quick brown"}},
+                    "highlight": {"fields": {"body": {}}}})
+    by_id = {h["_id"]: h for h in r["hits"]["hits"]}
+    assert "<em>quick</em>" in by_id["1"]["highlight"]["body"][0]
+    assert "<em>brown</em>" in by_id["1"]["highlight"]["body"][0]
+
+    r = svc.search({"query": {"match_phrase": {"body": "quick brown"}},
+                    "highlight": {"fields": {"body": {}}}})
+    hits = r["hits"]["hits"]
+    assert [h["_id"] for h in hits] == ["1"]
+    frag = hits[0]["highlight"]["body"][0]
+    assert "<em>quick</em> <em>brown</em> fox" in frag
+    # doc 2 has both terms but not the phrase: no hit at all
+    ind.close()
+
+
+def test_highlight_fragments_and_tags():
+    ind = IndicesService()
+    ind.create_index("h2", Settings({}), {
+        "properties": {"body": {"type": "text"}}}, {})
+    svc = ind.get("h2")
+    long_text = ("filler words here. " * 20 + "needle in the haystack. "
+                 + "more filler text. " * 20 + "another needle appears. "
+                 + "trailing filler. " * 10)
+    svc.index_doc("1", {"body": long_text})
+    svc.refresh()
+    r = svc.search({
+        "query": {"term": {"body": "needle"}},
+        "highlight": {"fields": {"body": {
+            "fragment_size": 60, "number_of_fragments": 2,
+            "pre_tags": ["<b>"], "post_tags": ["</b>"]}}}})
+    frags = r["hits"]["hits"][0]["highlight"]["body"]
+    assert 1 <= len(frags) <= 2
+    assert all("<b>needle</b>" in f for f in frags)
+    assert all(len(f) < 120 for f in frags)
+    ind.close()
+
+
+# ---------------- collapse ----------------
+
+
+@pytest.fixture(scope="module")
+def collapse_env():
+    ind, svc = make_indices(n_docs=300)
+    yield ind, svc
+    ind.close()
+
+
+def test_collapse_dedups_by_field(collapse_env):
+    _, svc = collapse_env
+    r = svc.search({"query": {"match": {"body": "alpha"}},
+                    "collapse": {"field": "tag"}, "size": 7})
+    hits = r["hits"]["hits"]
+    tags = [h["fields"]["tag"][0] for h in hits]
+    assert len(tags) == len(set(tags)), "collapse must dedup groups"
+    # each returned hit is the BEST of its group: rerun without collapse
+    full = svc.search({"query": {"match": {"body": "alpha"}}, "size": 400})
+    best_by_tag = {}
+    for h in full["hits"]["hits"]:
+        t = h["_source"]["tag"]
+        best_by_tag.setdefault(t, h["_id"])
+    for h in hits:
+        assert h["_id"] == best_by_tag[h["fields"]["tag"][0]]
+
+
+def test_collapse_with_sort(collapse_env):
+    _, svc = collapse_env
+    r = svc.search({"query": {"match_all": {}},
+                    "sort": [{"rank": "desc"}],
+                    "collapse": {"field": "tag"}, "size": 7})
+    hits = r["hits"]["hits"]
+    tags = [h["fields"]["tag"][0] for h in hits]
+    assert len(tags) == len(set(tags))
+    ranks = [h["sort"][0] for h in hits]
+    assert ranks == sorted(ranks, reverse=True)
