@@ -90,12 +90,13 @@ class IndexService:
     # ---- search (scatter-gather across shards) ----
 
     def search(self, request: dict, search_type: str = "query_then_fetch",
-               searchers=None) -> dict:
+               searchers=None, task=None) -> dict:
         if searchers is None:
-            fast = self.serving.try_search(request, search_type)
+            fast = self.serving.try_search(request, search_type, task=task)
             if fast is not None:
                 return fast
-        return self._search_dense(request, search_type, searchers=searchers)
+        return self._search_dense(request, search_type, searchers=searchers,
+                                  task=task)
 
     def msearch(self, requests: List[dict],
                 search_type: str = "query_then_fetch") -> List[dict]:
@@ -121,7 +122,7 @@ class IndexService:
         return results
 
     def _search_dense(self, request: dict, search_type: str = "query_then_fetch",
-                      searchers=None) -> dict:
+                      searchers=None, task=None) -> dict:
         import time as _time
 
         from elasticsearch_tpu.search.query_phase import QuerySearchResult, _sort_key, parse_sort
@@ -156,7 +157,8 @@ class IndexService:
                 ex = QueryExecutor(self.mapper, global_stats)
             shard_req = request if "_after_full" not in request else \
                 {**request, "_shard_id": shard_id}
-            qr = execute_query_phase(searcher, self.mapper, shard_req, executor=ex)
+            qr = execute_query_phase(searcher, self.mapper, shard_req,
+                                     executor=ex, task=task)
             shard_results.append(qr)
             for h in qr.hits:
                 per_shard_hits.append((shard_id, h))
@@ -211,7 +213,7 @@ class IndexService:
         took = int((_time.monotonic() - start) * 1000)
         resp = {
             "took": took,
-            "timed_out": False,
+            "timed_out": any(r.timed_out for r in shard_results),
             "_shards": {"total": len(self.shards), "successful": len(self.shards),
                         "skipped": 0, "failed": 0},
             "hits": {
@@ -225,6 +227,8 @@ class IndexService:
         finalize_hits_envelope(resp, request)
         if aggs is not None:
             resp["aggregations"] = aggs
+        if any(r.terminated_early for r in shard_results):
+            resp["terminated_early"] = True
         if cursor is not None:
             resp["_cursor"] = cursor
         return resp
@@ -232,19 +236,20 @@ class IndexService:
     # ---- scroll (ref: RestSearchScrollAction + SearchService scroll
     #      continuation over a pinned reader context) ----
 
-    def scroll_start(self, request: dict, keep_alive_s: float, registry) -> dict:
+    def scroll_start(self, request: dict, keep_alive_s: float, registry,
+                     task=None) -> dict:
         searchers = [s.acquire_searcher() for s in self.shards]
         ctx = registry.create(searchers=searchers, mapper=self.mapper,
                               index=self.name, keep_alive_s=keep_alive_s)
         body = {k: v for k, v in request.items() if k != "scroll"}
         resp = self._search_dense({**body, "_want_cursor": True},
-                                  searchers=searchers)
+                                  searchers=searchers, task=task)
         cursor = resp.pop("_cursor", None)
         ctx.scroll_state = {"request": body, "cursor": cursor}
         resp["_scroll_id"] = ctx.context_id
         return resp
 
-    def scroll_continue(self, ctx) -> dict:
+    def scroll_continue(self, ctx, task=None) -> dict:
         state = ctx.scroll_state or {}
         body = dict(state.get("request") or {})
         cursor = state.get("cursor")
@@ -257,7 +262,8 @@ class IndexService:
         body["_after_full"] = cursor
         body["_want_cursor"] = True
         body.pop("from", None)
-        resp = self._search_dense(body, searchers=ctx.extra["searchers"])
+        resp = self._search_dense(body, searchers=ctx.extra["searchers"],
+                                  task=task)
         new_cursor = resp.pop("_cursor", None)
         ctx.scroll_state = {"request": state.get("request"),
                             "cursor": new_cursor or {"values": []}}
@@ -296,17 +302,15 @@ def _analyzer_config(meta: IndexMetadata) -> dict:
 
 
 def parse_keep_alive(value, default_s: float = 300.0) -> float:
-    """'30s' / '1m' / '2h' / milliseconds int -> seconds."""
+    """'30s' / '1m' / '2h' -> seconds (one duration parser for the repo:
+    tasks/task_manager.parse_timeout_ms; plain numbers are SECONDS here)."""
+    from elasticsearch_tpu.tasks.task_manager import parse_timeout_ms
+
     if value is None:
         return default_s
     if isinstance(value, (int, float)):
         return float(value)
-    s = str(value).strip().lower()
-    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
-    for suffix in ("ms", "s", "m", "h", "d"):
-        if s.endswith(suffix):
-            return float(s[: -len(suffix)]) * units[suffix]
-    return float(s)
+    return parse_timeout_ms(value) / 1000.0
 
 
 class IndicesService:
@@ -348,15 +352,18 @@ class IndicesService:
     def close_pit(self, pit_id: str) -> bool:
         return self.contexts.release(pit_id)
 
-    def scroll_start(self, index: str, request: dict, keep_alive_s: float) -> dict:
+    def scroll_start(self, index: str, request: dict, keep_alive_s: float,
+                     task=None) -> dict:
         self._ensure_reaper()
-        return self.get(index).scroll_start(request, keep_alive_s, self.contexts)
+        return self.get(index).scroll_start(request, keep_alive_s,
+                                            self.contexts, task=task)
 
-    def scroll_continue(self, scroll_id: str, keep_alive_s: Optional[float] = None) -> dict:
+    def scroll_continue(self, scroll_id: str, keep_alive_s: Optional[float] = None,
+                        task=None) -> dict:
         ctx = self.contexts.get(scroll_id)
         if keep_alive_s:
             ctx.keep_alive_s = keep_alive_s
-        return self.get(ctx.index).scroll_continue(ctx)
+        return self.get(ctx.index).scroll_continue(ctx, task=task)
 
     def create_index(self, name: str, settings: Settings, mappings: dict,
                      aliases: Dict[str, dict] | None = None) -> IndexMetadata:

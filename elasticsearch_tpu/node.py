@@ -37,6 +37,9 @@ class Node:
         self.indices = IndicesService(data_path)
         self.transport = TransportService(self.node_id)
         self.breakers = HierarchyCircuitBreakerService()
+        from elasticsearch_tpu.tasks import TaskManager
+
+        self.tasks = TaskManager(self.node_id)
         self._register_actions()
 
     # ---- cluster-state updates (single-threaded master semantics,

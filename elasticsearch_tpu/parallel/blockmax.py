@@ -231,7 +231,7 @@ class BlockMaxBM25:
         return W, qblocks, qidf
 
     def _select(self, queries: List[List[Tuple[str, float]]],
-                theta: np.ndarray
+                theta: np.ndarray, check=None,
                 ) -> Tuple[List[Dict[str, List[np.ndarray] | None]], int]:
         """Block-max culling with doc-range refinement (the BlockMaxWAND
         bound, ref: Lucene MaxScoreCache + impacts): block b of sparse term i
@@ -249,6 +249,8 @@ class BlockMaxBM25:
         sel: List[Dict[str, List[np.ndarray] | None]] = []
         max_total = 1
         for qi, terms in enumerate(queries):
+            if check is not None and qi % 64 == 0:
+                check()   # cooperative cancellation inside the host loop
             entries = [(t, b, self._terms.get(t)) for t, b in terms]
             entries = [(t, b, m) for t, b, m in entries if m is not None]
             th = float(theta[qi])
@@ -298,7 +300,8 @@ class BlockMaxBM25:
         """Batched exact BM25 top-k. Returns (scores, shard, ord) [Q, k]."""
         return self.search_many([queries], k)[0]
 
-    def search_many(self, batches: Sequence[List], k: int = 10):
+    def search_many(self, batches: Sequence[List], k: int = 10,
+                    check=None):
         """Pipeline many query batches through the two-pass executor with
         exactly TWO host<->device round trips total: all pass-A programs
         dispatch, thetas come back in one stacked transfer, all pass-B
@@ -315,7 +318,7 @@ class BlockMaxBM25:
         import time as _time
 
         timing = {"assemble_a": 0.0, "theta_fetch": 0.0, "select": 0.0,
-                  "assemble_b": 0.0, "dispatch_b": 0.0, "result_fetch": 0.0,
+                  "assemble_dispatch_b": 0.0, "result_fetch": 0.0,
                   "overflow": 0.0, "n_queries": 0, "n_overflow": 0}
         self.last_timing = timing
         dp = self.mesh.shape.get("dp", 1)
@@ -360,7 +363,7 @@ class BlockMaxBM25:
         timing["theta_fetch"] = t2 - t1
 
         # ---- selection, then global grouping by bucket ----
-        selections, _ = self._select(flat, thetas)
+        selections, _ = self._select(flat, thetas, check=check)
         timing["select"] = _time.monotonic() - t2
         totals = np.zeros(len(flat), np.int64)
         for qi, terms in enumerate(flat):
@@ -401,6 +404,8 @@ class BlockMaxBM25:
                     pad = qc - len(chunk)
                     chunk = chunk + [chunk[-1]] * pad
                     sels = sels + [sels[-1]] * pad
+                if check is not None:
+                    check()
                 W, qb, qi_ = self._assemble(chunk, sels, bucket)
                 packed_b = _hybrid_program(
                     self.stacked.block_docs, self.stacked.block_scores,
@@ -409,7 +414,7 @@ class BlockMaxBM25:
                     mesh=self.mesh, k=k)
                 pending.append((idxs, packed_b))
         t4 = _time.monotonic()
-        timing["assemble_b"] = timing["dispatch_b"] = t4 - t3
+        timing["assemble_dispatch_b"] = t4 - t3
 
         # one transfer: all groups' packed results (flattened; ragged shapes)
         out_all = np.zeros((len(flat), 3, k), np.float32)
@@ -496,7 +501,8 @@ class BlockMaxBM25:
                            jnp.asarray(W), mesh=self.mesh, k=k)
         return np.asarray(packed)[0]
 
-    def search_bool(self, queries: Sequence[dict], k: int = 10):
+    def search_bool(self, queries: Sequence[dict], k: int = 10,
+                    check=None):
         """Batched exact `bool` top-k on device (BASELINE config 2 — the
         reference's WAND/conjunction path, ref: Lucene BooleanWeight +
         MinShouldMatchSumScorer driven through BlockMaxConjunctionScorer).
@@ -548,6 +554,8 @@ class BlockMaxBM25:
         for (bucket, qc), members in sorted(groups.items()):
             qc = max(qc, self.mesh.shape.get("dp", 1))
             for off in range(0, len(members), qc):
+                if check is not None:
+                    check()
                 grp = members[off: off + qc]
                 pad = qc - len(grp)
                 use = grp + [grp[-1]] * pad

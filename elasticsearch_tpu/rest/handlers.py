@@ -83,6 +83,10 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("DELETE", "/_search/scroll", h.scroll_clear)
     r("POST", "/{index}/_pit", h.open_pit)
     r("DELETE", "/_pit", h.close_pit)
+    r("GET", "/_tasks", h.list_tasks)
+    r("POST", "/_tasks/_cancel", h.cancel_tasks)
+    r("GET", "/_tasks/{task_id}", h.get_task)
+    r("POST", "/_tasks/{task_id}/_cancel", h.cancel_task)
     r("POST", "/_msearch", h.msearch)
     r("GET", "/_msearch", h.msearch)
     r("POST", "/{index}/_msearch", h.msearch)
@@ -427,6 +431,12 @@ class _Handlers:
         from elasticsearch_tpu.index.index_service import parse_keep_alive
 
         body = dict(req.body or {})
+        # url params mirror body fields (ref: RestSearchAction)
+        if req.param("q") is not None:
+            body["query"] = {"match": {"_all": req.param("q")}}  # minimal q= support
+        for p in ("size", "from"):
+            if req.param(p) is not None:
+                body[p] = req.param_int(p)
         # point-in-time searches carry their index inside the pinned context
         pit = body.get("pit")
         if pit:
@@ -435,25 +445,29 @@ class _Handlers:
                 ctx.keep_alive_s = parse_keep_alive(pit["keep_alive"])
             clean = {k: v for k, v in body.items() if k != "pit"}
             svc = self.node.indices.get(ctx.index)
-            resp = svc.search(clean, searchers=ctx.extra["searchers"])
+            with self.node.tasks.task("indices:data/read/search",
+                                      f"pit[{ctx.index}]") as task:
+                resp = svc.search(clean, searchers=ctx.extra["searchers"],
+                                  task=task)
             resp["pit_id"] = pit["id"]
             return _ok(resp)
         names = self._resolve(req.param("index"), require=True)
-        # url params mirror body fields (ref: RestSearchAction)
-        if req.param("q") is not None:
-            body["query"] = {"match": {"_all": req.param("q")}}  # minimal q= support
-        for p in ("size", "from"):
-            if req.param(p) is not None:
-                body[p] = req.param_int(p)
         search_type = req.param("search_type", "query_then_fetch")
-        if req.param("scroll") is not None:
-            if len(names) != 1:
-                raise IllegalArgumentError("scroll requires a single index")
-            keep = parse_keep_alive(req.param("scroll"))
-            return _ok(self.node.indices.scroll_start(names[0], body, keep))
-        if len(names) == 1:
-            return _ok(self.node.indices.get(names[0]).search(body, search_type))
-        return _ok(self._multi_index_search(names, body, search_type))
+        # every search runs under a registered cancellable task
+        # (ref: tasks/TaskManager.java:71 via TransportAction.execute)
+        with self.node.tasks.task("indices:data/read/search",
+                                  f"indices[{','.join(names)}]") as task:
+            if req.param("scroll") is not None:
+                if len(names) != 1:
+                    raise IllegalArgumentError("scroll requires a single index")
+                keep = parse_keep_alive(req.param("scroll"))
+                return _ok(self.node.indices.scroll_start(names[0], body, keep,
+                                                          task=task))
+            if len(names) == 1:
+                return _ok(self.node.indices.get(names[0]).search(
+                    body, search_type, task=task))
+            return _ok(self._multi_index_search(names, body, search_type,
+                                                task=task))
 
     def scroll_next(self, req: RestRequest) -> RestResponse:
         from elasticsearch_tpu.index.index_service import parse_keep_alive
@@ -464,7 +478,10 @@ class _Handlers:
             raise IllegalArgumentError("scroll_id is required")
         keep = parse_keep_alive(body.get("scroll") or req.param("scroll"),
                                 0.0) or None
-        return _ok(self.node.indices.scroll_continue(scroll_id, keep))
+        with self.node.tasks.task("indices:data/read/scroll",
+                                  f"scroll[{scroll_id[:8]}]") as task:
+            return _ok(self.node.indices.scroll_continue(scroll_id, keep,
+                                                         task=task))
 
     def scroll_clear(self, req: RestRequest) -> RestResponse:
         body = dict(req.body or {})
@@ -489,12 +506,52 @@ class _Handlers:
         ok = self.node.indices.close_pit(body.get("id", ""))
         return _ok({"succeeded": ok, "num_freed": int(ok)})
 
+    # ---------- tasks (ref: RestListTasksAction, RestCancelTasksAction) ----------
+
+    def list_tasks(self, req: RestRequest) -> RestResponse:
+        tasks = self.node.tasks.list(req.param("actions"))
+        return _ok({"nodes": {self.node.tasks.node_id: {
+            "tasks": {f"{t.node}:{t.id}": t.to_dict() for t in tasks}}}})
+
+    def get_task(self, req: RestRequest) -> RestResponse:
+        tid = req.param("task_id", "")
+        try:
+            task_num = int(tid.split(":")[-1])
+        except ValueError:
+            raise IllegalArgumentError(f"malformed task id [{tid}]")
+        t = self.node.tasks.get(task_num)
+        if t is None:
+            from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+            e = ElasticsearchTpuError(f"task [{tid}] isn't running")
+            e.status = 404
+            raise e
+        return _ok({"completed": False, "task": t.to_dict()})
+
+    def cancel_task(self, req: RestRequest) -> RestResponse:
+        tid = req.param("task_id", "")
+        try:
+            task_num = int(tid.split(":")[-1])
+        except ValueError:
+            raise IllegalArgumentError(f"malformed task id [{tid}]")
+        t = self.node.tasks.cancel(task_num)
+        return _ok({"nodes": {self.node.tasks.node_id: {
+            "tasks": {f"{t.node}:{t.id}": t.to_dict()} if t else {}}}})
+
+    def cancel_tasks(self, req: RestRequest) -> RestResponse:
+        actions = req.param("actions", "*")
+        cancelled = self.node.tasks.cancel_matching(actions)
+        return _ok({"nodes": {self.node.tasks.node_id: {
+            "tasks": {f"{t.node}:{t.id}": t.to_dict() for t in cancelled}}}})
+
     def search_all(self, req: RestRequest) -> RestResponse:
         req.params.setdefault("index", "_all")
         return self.search(req)
 
-    def _multi_index_search(self, names: List[str], body: dict, search_type: str) -> dict:
-        responses = [(n, self.node.indices.get(n).search(body, search_type)) for n in names]
+    def _multi_index_search(self, names: List[str], body: dict, search_type: str,
+                            task=None) -> dict:
+        responses = [(n, self.node.indices.get(n).search(body, search_type, task=task))
+                     for n in names]
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         all_hits = []

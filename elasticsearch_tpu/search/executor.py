@@ -121,9 +121,14 @@ class QueryExecutor:
     def __init__(self, mapper: MapperService, stats: ShardStats):
         self.mapper = mapper
         self.stats = stats
+        # cooperative cancellation hook (ref: ContextIndexSearcher.java:66
+        # addQueryCancellation) — set by the query phase when a Task exists
+        self.check = None
 
     def execute(self, query: q.Query, leaf: LeafContext):
         """Returns (scores f32[n], mask bool[n]) device arrays."""
+        if self.check is not None:
+            self.check()
         n = leaf.n_docs
         if n == 0:
             return jnp.zeros(0, jnp.float32), jnp.zeros(0, bool)
@@ -437,7 +442,12 @@ class QueryExecutor:
         fp = leaf.segment.postings.get(field)
         if fp is None:
             return self._none(leaf)
-        ords = [i for i, t in enumerate(fp.terms) if predicate(t)]
+        ords = []
+        for i, t in enumerate(fp.terms):
+            if self.check is not None and i % 65536 == 0:
+                self.check()   # huge dictionaries: stay cancellable mid-scan
+            if predicate(t):
+                ords.append(i)
         return self._terms_mask_by_ords(leaf, field, ords)
 
     def _terms_mask_by_ords(self, leaf, field, ords):

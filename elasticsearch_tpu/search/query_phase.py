@@ -41,6 +41,8 @@ class QuerySearchResult:
     max_score: Optional[float]
     # reduced aggregation PARTIALS for this shard (coordinator finalizes)
     aggregations: Optional[dict] = None
+    timed_out: bool = False
+    terminated_early: bool = False
 
 
 def parse_sort(sort_spec) -> List[Tuple[str, str]]:
@@ -66,10 +68,18 @@ def execute_query_phase(
     request: dict,
     *,
     executor: QueryExecutor | None = None,
+    task=None,
 ) -> QuerySearchResult:
+    from elasticsearch_tpu.tasks.task_manager import Deadline, parse_timeout_ms
+
     lvs = leaves(searcher)
     stats = ShardStats(searcher.views)
     ex = executor or QueryExecutor(mapper, stats)
+    if task is not None:
+        ex.check = task.check
+    deadline = Deadline(parse_timeout_ms(request.get("timeout")))
+    terminate_after = request.get("terminate_after")
+    terminated_early = False
 
     query = parse_query(request.get("query")) if request.get("query") else None
     knn_spec = request.get("knn")
@@ -147,6 +157,13 @@ def execute_query_phase(
     for leaf_idx, leaf in enumerate(lvs):
         if leaf.n_docs == 0:
             continue
+        if task is not None:
+            task.check()
+        if deadline.expired or (terminate_after is not None
+                                and total >= int(terminate_after)):
+            terminated_early = terminate_after is not None and \
+                total >= int(terminate_after)
+            break
         if query is not None:
             scores, mask = ex.execute(query, leaf)
         else:
@@ -225,18 +242,22 @@ def execute_query_phase(
         )
 
         aggs, _ = parse_aggs(aggs_spec)
-        partials = [
-            collect_leaf(aggs, AggContext(leaf=leaf, mapper=mapper, executor=ex,
-                                          live=np.asarray(leaf.live_dev()),
-                                          scores=sc), m)
-            for leaf, m, sc in leaf_masks
-        ]
+        partials = []
+        for leaf, m, sc in leaf_masks:
+            if task is not None:
+                task.check()
+            partials.append(collect_leaf(
+                aggs, AggContext(leaf=leaf, mapper=mapper, executor=ex,
+                                 live=np.asarray(leaf.live_dev()),
+                                 scores=sc), m))
         # reduce leaves within the shard; the coordinator reduces shards and
         # finalizes (ref P6: partials stay commutative until the final reduce)
         agg_partials = reduce_partials(aggs, partials)
 
     return QuerySearchResult(total=total, relation=relation, hits=window,
-                             max_score=max_score, aggregations=agg_partials)
+                             max_score=max_score, aggregations=agg_partials,
+                             timed_out=deadline.timed_out,
+                             terminated_early=terminated_early)
 
 
 def collapse_value(seg, ord_: int, field: str):

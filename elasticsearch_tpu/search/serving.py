@@ -47,6 +47,7 @@ from elasticsearch_tpu.index.positions import phrase_freqs
 from elasticsearch_tpu.ops import bm25_idf
 from elasticsearch_tpu.search import queries as q
 from elasticsearch_tpu.search.queries import parse_query
+from elasticsearch_tpu.tasks.task_manager import TaskCancelledError
 
 K1 = 1.2
 B = 0.75
@@ -510,12 +511,13 @@ class ServingContext:
 
     # ---- entry points ----
 
-    def try_search(self, request: dict, search_type: str) -> Optional[dict]:
-        out = self.try_msearch([request], search_type)
+    def try_search(self, request: dict, search_type: str,
+                   task=None) -> Optional[dict]:
+        out = self.try_msearch([request], search_type, task=task)
         return out[0] if out else None
 
-    def try_msearch(self, requests: Sequence[dict], search_type: str
-                    ) -> List[Optional[dict]]:
+    def try_msearch(self, requests: Sequence[dict], search_type: str,
+                    task=None) -> List[Optional[dict]]:
         """Serve each eligible body; None where the dense path must run.
         Disjunctive bodies on the same field batch into ONE device dispatch."""
         if len(self.svc.shards) > 1 and search_type != "dfs_query_then_fetch":
@@ -539,16 +541,22 @@ class ServingContext:
                     by_field.setdefault(plan.field, []).append(i)
                 continue
             try:
+                if task is not None:
+                    task.check()
                 out[i] = self._conjunctive(plan, snap, requests[i], start)
+            except TaskCancelledError:
+                raise
             except Exception:
                 out[i] = None
         for field, idxs in by_field.items():
             try:
                 results = self._disjunctive_batch(
                     field, [plans[i] for i in idxs],
-                    [requests[i] for i in idxs], snap)
+                    [requests[i] for i in idxs], snap, task=task)
                 for i, r in zip(idxs, results):
                     out[i] = r
+            except TaskCancelledError:
+                raise
             except Exception:
                 pass
         return out
@@ -560,13 +568,14 @@ class ServingContext:
         max_docs = max(p.segment.n_docs for p in snap.partitions)
         return k <= max_docs
 
-    def _disjunctive_batch(self, field: str, plans, requests, snap):
+    def _disjunctive_batch(self, field: str, plans, requests, snap, task=None):
         start = time.monotonic()
         bm = snap.blockmax(field)
         k = max(int(r.get("from", 0)) + int(r.get("size", 10))
                 for r in requests)
         queries = [p.disj for p in plans]
-        scores, parts, ords = bm.search_many([queries], k=k)[0]
+        check = task.check if task is not None else None
+        scores, parts, ords = bm.search_many([queries], k=k, check=check)[0]
         results = []
         for qi, (plan, request) in enumerate(zip(plans, requests)):
             hits = []

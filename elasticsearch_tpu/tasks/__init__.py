@@ -1,0 +1,5 @@
+from elasticsearch_tpu.tasks.task_manager import (
+    Task, TaskCancelledError, TaskManager,
+)
+
+__all__ = ["Task", "TaskCancelledError", "TaskManager"]

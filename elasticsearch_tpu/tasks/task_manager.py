@@ -1,0 +1,176 @@
+"""Task registry with cooperative cancellation.
+
+Re-designs the reference's task management (ref: tasks/TaskManager.java:71
+register/unregister, tasks/CancellableTask.java, and the cancellation
+checks ContextIndexSearcher.java:66 threads through collectors): every
+long-running request registers a Task; cancellation flips a flag that the
+compute paths CHECK at their loop boundaries — between device dispatches,
+between leaves, inside host selection/expansion loops — so a runaway query
+returns promptly instead of running to completion.
+
+The TPU twist: a dispatched XLA program itself cannot be interrupted, but
+every program here is bounded (fixed shapes, one batch chunk), so the
+check granularity is one dispatch — milliseconds, not the whole query.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+
+class TaskCancelledError(ElasticsearchTpuError):
+    status = 400
+    error_type = "task_cancelled_exception"
+
+
+@dataclass
+class Task:
+    id: int
+    node: str
+    action: str
+    description: str
+    start_time_ms: int
+    cancellable: bool = True
+    parent_task_id: Optional[str] = None
+    _cancelled: threading.Event = field(default_factory=threading.Event,
+                                        repr=False)
+    cancel_reason: Optional[str] = None
+
+    @property
+    def is_cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def cancel(self, reason: str = "by user request") -> None:
+        self.cancel_reason = reason
+        self._cancelled.set()
+
+    def check(self) -> None:
+        """Raise if cancelled — called from compute loop boundaries."""
+        if self._cancelled.is_set():
+            raise TaskCancelledError(
+                f"task [{self.node}:{self.id}] cancelled: {self.cancel_reason}")
+
+    def to_dict(self) -> dict:
+        return {
+            "node": self.node,
+            "id": self.id,
+            "type": "transport",
+            "action": self.action,
+            "description": self.description,
+            "start_time_in_millis": self.start_time_ms,
+            "running_time_in_nanos": int(
+                (time.time() * 1000 - self.start_time_ms) * 1e6),
+            "cancellable": self.cancellable,
+            "cancelled": self.is_cancelled,
+            **({"parent_task_id": self.parent_task_id}
+               if self.parent_task_id else {}),
+        }
+
+
+class TaskManager:
+    """Node-level task registry (ref: tasks/TaskManager.java:71)."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self._tasks: Dict[int, Task] = {}
+        self._ids = itertools.count(1)
+
+    def register(self, action: str, description: str = "",
+                 cancellable: bool = True,
+                 parent_task_id: Optional[str] = None) -> Task:
+        task = Task(id=next(self._ids), node=self.node_id, action=action,
+                    description=description,
+                    start_time_ms=int(time.time() * 1000),
+                    cancellable=cancellable, parent_task_id=parent_task_id)
+        with self._lock:
+            self._tasks[task.id] = task
+        return task
+
+    def unregister(self, task: Task) -> None:
+        with self._lock:
+            self._tasks.pop(task.id, None)
+
+    def task(self, action: str, description: str = "", **kw):
+        """Context manager: register on enter, unregister on exit."""
+        manager = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t = manager.register(action, description, **kw)
+                return self.t
+
+            def __exit__(self, *exc):
+                manager.unregister(self.t)
+                return False
+
+        return _Ctx()
+
+    def get(self, task_id: int) -> Optional[Task]:
+        with self._lock:
+            return self._tasks.get(task_id)
+
+    def list(self, actions: Optional[str] = None) -> List[Task]:
+        import fnmatch
+
+        with self._lock:
+            tasks = list(self._tasks.values())
+        if actions:
+            pats = actions.split(",")
+            tasks = [t for t in tasks
+                     if any(fnmatch.fnmatchcase(t.action, p) for p in pats)]
+        return tasks
+
+    def cancel(self, task_id: int, reason: str = "by user request") -> Optional[Task]:
+        t = self.get(task_id)
+        if t is not None and t.cancellable:
+            t.cancel(reason)
+        return t
+
+    def cancel_matching(self, actions: str, reason: str = "by user request") -> List[Task]:
+        out = []
+        for t in self.list(actions):
+            if t.cancellable:
+                t.cancel(reason)
+                out.append(t)
+        return out
+
+
+def parse_timeout_ms(value) -> Optional[float]:
+    """'100ms' / '2s' / '1m' / int(ms) -> milliseconds."""
+    if value is None:
+        return None
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip().lower()
+    for suffix, mult in (("ms", 1.0), ("s", 1000.0), ("m", 60000.0),
+                         ("h", 3600000.0), ("d", 86400000.0)):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * mult
+    return float(s)
+
+
+class Deadline:
+    """Per-request soft deadline for timeout/terminate_after semantics
+    (ref: search/internal/ContextIndexSearcher timeout runnable +
+    QueryPhase.executeInternal terminateAfter): compute paths poll
+    `expired` at leaf boundaries and return PARTIAL results with
+    timed_out=true, unlike cancellation which raises."""
+
+    def __init__(self, timeout_ms: Optional[float]):
+        self._deadline = (time.monotonic() + timeout_ms / 1000.0
+                          if timeout_ms is not None else None)
+        self.timed_out = False
+
+    @property
+    def expired(self) -> bool:
+        if self._deadline is not None and time.monotonic() > self._deadline:
+            self.timed_out = True
+            return True
+        return False

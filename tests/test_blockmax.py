@@ -267,8 +267,6 @@ def test_search_bool_matches_brute_force(n_shards):
         assert len(got) == len(want), f"query {qi_}: {spec}"
         for (es, esh, eo), (gs, gsh, go) in zip(want, got):
             assert abs(es - gs) <= 2e-5 * abs(es) + 2e-5, f"query {qi_}"
-            if abs(es - gs) == 0.0 or True:
-                pass
         # order equality wherever adjacent scores separated beyond f32 noise
         ws = np.asarray([w[0] for w in want])
         gaps = np.abs(np.diff(ws)) > 2e-5 * np.abs(ws[:-1]) + 2e-5
