@@ -303,14 +303,21 @@ def _analyzer_config(meta: IndexMetadata) -> dict:
 
 def parse_keep_alive(value, default_s: float = 300.0) -> float:
     """'30s' / '1m' / '2h' -> seconds (one duration parser for the repo:
-    tasks/task_manager.parse_timeout_ms; plain numbers are SECONDS here)."""
+    tasks/task_manager.parse_timeout_ms; bare numbers are SECONDS here,
+    matching this API's pre-existing contract)."""
     from elasticsearch_tpu.tasks.task_manager import parse_timeout_ms
 
     if value is None:
         return default_s
     if isinstance(value, (int, float)):
         return float(value)
-    return parse_timeout_ms(value) / 1000.0
+    s = str(value).strip()
+    try:
+        return float(s)          # unitless string -> seconds
+    except ValueError:
+        pass
+    ms = parse_timeout_ms(s)
+    return (ms / 1000.0) if ms is not None else default_s
 
 
 class IndicesService:

@@ -40,6 +40,10 @@ class Node:
         from elasticsearch_tpu.tasks import TaskManager
 
         self.tasks = TaskManager(self.node_id)
+        from elasticsearch_tpu.snapshots import SnapshotsService
+
+        self.snapshots = SnapshotsService(
+            self.indices, lambda name, body: self.create_index(name, body))
         self._register_actions()
 
     # ---- cluster-state updates (single-threaded master semantics,

@@ -83,6 +83,15 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("DELETE", "/_search/scroll", h.scroll_clear)
     r("POST", "/{index}/_pit", h.open_pit)
     r("DELETE", "/_pit", h.close_pit)
+    # snapshots (ref: RestPutRepositoryAction, RestCreateSnapshotAction,
+    # RestRestoreSnapshotAction, RestDeleteSnapshotAction)
+    r("PUT", "/_snapshot/{repo}", h.put_repository)
+    r("GET", "/_snapshot/{repo}", h.get_repository)
+    r("PUT", "/_snapshot/{repo}/{snapshot}", h.create_snapshot)
+    r("POST", "/_snapshot/{repo}/{snapshot}", h.create_snapshot)
+    r("GET", "/_snapshot/{repo}/{snapshot}", h.get_snapshot)
+    r("DELETE", "/_snapshot/{repo}/{snapshot}", h.delete_snapshot)
+    r("POST", "/_snapshot/{repo}/{snapshot}/_restore", h.restore_snapshot)
     r("GET", "/_tasks", h.list_tasks)
     r("POST", "/_tasks/_cancel", h.cancel_tasks)
     r("GET", "/_tasks/{task_id}", h.get_task)
@@ -506,6 +515,56 @@ class _Handlers:
         ok = self.node.indices.close_pit(body.get("id", ""))
         return _ok({"succeeded": ok, "num_freed": int(ok)})
 
+    # ---------- snapshots ----------
+
+    def put_repository(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        self.node.snapshots.put_repository(
+            req.param("repo"), body.get("type", ""),
+            body.get("settings", {}))
+        return _ok({"acknowledged": True})
+
+    def get_repository(self, req: RestRequest) -> RestResponse:
+        repo = self.node.snapshots.repository(req.param("repo"))
+        return _ok({repo.name: {"type": "fs",
+                                "settings": {"location": repo.location}}})
+
+    def create_snapshot(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        indices = body.get("indices")
+        if isinstance(indices, str):
+            indices = [i for n in indices.split(",")
+                       for i in self._resolve(n, require=True)]
+        meta = self.node.snapshots.create(
+            req.param("repo"), req.param("snapshot"), indices)
+        return _ok({"snapshot": meta})
+
+    def get_snapshot(self, req: RestRequest) -> RestResponse:
+        import fnmatch
+
+        snap = req.param("snapshot")
+        if snap == "_all" or "*" in snap:
+            snaps = self.node.snapshots.list(req.param("repo"))
+            if snap != "_all":
+                snaps = [s for s in snaps
+                         if fnmatch.fnmatchcase(s["snapshot"], snap)]
+            return _ok({"snapshots": snaps})
+        return _ok({"snapshots": [
+            self.node.snapshots.get(req.param("repo"), snap)]})
+
+    def delete_snapshot(self, req: RestRequest) -> RestResponse:
+        self.node.snapshots.delete(req.param("repo"), req.param("snapshot"))
+        return _ok({"acknowledged": True})
+
+    def restore_snapshot(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        indices = body.get("indices")
+        if isinstance(indices, str):
+            indices = indices.split(",")
+        return _ok(self.node.snapshots.restore(
+            req.param("repo"), req.param("snapshot"), indices,
+            body.get("rename_pattern"), body.get("rename_replacement")))
+
     # ---------- tasks (ref: RestListTasksAction, RestCancelTasksAction) ----------
 
     def list_tasks(self, req: RestRequest) -> RestResponse:
@@ -535,8 +594,14 @@ class _Handlers:
         except ValueError:
             raise IllegalArgumentError(f"malformed task id [{tid}]")
         t = self.node.tasks.cancel(task_num)
+        if t is None:
+            from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+            e = ElasticsearchTpuError(f"task [{tid}] isn't running")
+            e.status = 404
+            raise e
         return _ok({"nodes": {self.node.tasks.node_id: {
-            "tasks": {f"{t.node}:{t.id}": t.to_dict()} if t else {}}}})
+            "tasks": {f"{t.node}:{t.id}": t.to_dict()}}}})
 
     def cancel_tasks(self, req: RestRequest) -> RestResponse:
         actions = req.param("actions", "*")

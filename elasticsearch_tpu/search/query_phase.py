@@ -78,7 +78,7 @@ def execute_query_phase(
     if task is not None:
         ex.check = task.check
     deadline = Deadline(parse_timeout_ms(request.get("timeout")))
-    terminate_after = request.get("terminate_after")
+    terminate_after = request.get("terminate_after") or None  # 0 = not set
     terminated_early = False
 
     query = parse_query(request.get("query")) if request.get("query") else None

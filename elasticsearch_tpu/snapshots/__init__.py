@@ -1,0 +1,9 @@
+from elasticsearch_tpu.snapshots.repository import (
+    FsRepository, RepositoryError, SnapshotMissingError,
+)
+from elasticsearch_tpu.snapshots.service import (
+    InvalidSnapshotNameError, SnapshotsService,
+)
+
+__all__ = ["FsRepository", "RepositoryError", "SnapshotMissingError",
+           "InvalidSnapshotNameError", "SnapshotsService"]

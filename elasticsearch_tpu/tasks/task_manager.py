@@ -128,9 +128,17 @@ class TaskManager:
         return tasks
 
     def cancel(self, task_id: int, reason: str = "by user request") -> Optional[Task]:
+        """Returns the task after cancelling, None if unknown; raises on a
+        non-cancellable task (ES: 400 for cancel of a non-cancellable)."""
         t = self.get(task_id)
-        if t is not None and t.cancellable:
-            t.cancel(reason)
+        if t is None:
+            return None
+        if not t.cancellable:
+            e = ElasticsearchTpuError(
+                f"task [{t.node}:{t.id}] is not cancellable")
+            e.status = 400
+            raise e
+        t.cancel(reason)
         return t
 
     def cancel_matching(self, actions: str, reason: str = "by user request") -> List[Task]:
@@ -143,12 +151,15 @@ class TaskManager:
 
 
 def parse_timeout_ms(value) -> Optional[float]:
-    """'100ms' / '2s' / '1m' / int(ms) -> milliseconds."""
+    """'100ms' / '2s' / '1m' / int(ms) -> milliseconds. -1 (ES's "no
+    timeout" sentinel) parses to None."""
     if value is None:
         return None
     if isinstance(value, (int, float)):
-        return float(value)
+        return float(value) if value >= 0 else None
     s = str(value).strip().lower()
+    if s == "-1":
+        return None
     for suffix, mult in (("ms", 1.0), ("s", 1000.0), ("m", 60000.0),
                          ("h", 3600000.0), ("d", 86400000.0)):
         if s.endswith(suffix):
@@ -165,7 +176,8 @@ class Deadline:
 
     def __init__(self, timeout_ms: Optional[float]):
         self._deadline = (time.monotonic() + timeout_ms / 1000.0
-                          if timeout_ms is not None else None)
+                          if timeout_ms is not None and timeout_ms >= 0
+                          else None)
         self.timed_out = False
 
     @property
