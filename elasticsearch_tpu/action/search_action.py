@@ -35,6 +35,7 @@ from elasticsearch_tpu.transport.service import TransportService
 ACTION_QUERY = "indices:data/read/search[phase/query]"
 ACTION_FETCH = "indices:data/read/search[phase/fetch/id]"
 ACTION_FREE = "indices:data/read/search[free_context]"
+ACTION_CAN_MATCH = "indices:data/read/search[can_match]"
 
 
 def _py(v):
@@ -55,6 +56,12 @@ class SearchActionService:
         transport.register_request_handler(ACTION_QUERY, self._on_shard_query)
         transport.register_request_handler(ACTION_FETCH, self._on_shard_fetch)
         transport.register_request_handler(ACTION_FREE, self._on_free_context)
+        transport.register_request_handler(ACTION_CAN_MATCH,
+                                           self._on_can_match)
+        # adaptive replica selection state: EWMA of per-node shard-query
+        # service time (ref: OperationRouting.java:34 rankShardsAndUpdateStats
+        # / ResponseCollectorService)
+        self._node_ewma_ms: Dict[str, float] = {}
 
     # ---------------- shard-level handlers (data node) ----------------
 
@@ -102,6 +109,49 @@ class SearchActionService:
         freed = self.contexts.release(req.payload["context_id"])
         return {"freed": freed}
 
+    def _on_can_match(self, req) -> dict:
+        """Lightweight shard pre-filter (ref:
+        action/search/CanMatchPreFilterSearchPhase.java): no scoring — just
+        'could any document here match?'. Cheap dictionary/column-bound
+        checks against every required term of the query."""
+        p = req.payload
+        try:
+            inst = self.shards.get_shard(p["index"], p["shard_id"])
+        except Exception:  # noqa: BLE001 — unknown shard: let query phase fail
+            return {"can_match": True}
+        terms = p.get("required_terms") or []
+        if not terms:
+            return {"can_match": True}
+        searcher = inst.engine.acquire_searcher()
+        for field, term in terms:
+            ft = inst.mapper.field_type(field)
+            if ft is None or ft.family not in ("inverted", "keyword"):
+                continue   # column-served fields have no postings to probe
+            if not any(v.segment.term_stats(field, term)[0] > 0
+                       for v in searcher.views):
+                return {"can_match": False}
+        return {"can_match": True}
+
+    @staticmethod
+    def _required_terms(body: dict) -> List[Tuple[str, str]]:
+        """(field, term) pairs every match must contain — conservative: only
+        top-level term queries and bool.must/filter term queries qualify."""
+        query = body.get("query") or {}
+        out: List[Tuple[str, str]] = []
+
+        def leaf(spec):
+            if not isinstance(spec, dict):
+                return
+            if "term" in spec and isinstance(spec["term"], dict):
+                for f, v in spec["term"].items():
+                    out.append((f, str(v["value"] if isinstance(v, dict)
+                                       else v)))
+        leaf(query)
+        b = query.get("bool") or {}
+        for clause in list(b.get("must", [])) + list(b.get("filter", [])):
+            leaf(clause)
+        return out
+
     # ---------------- coordinator (any node) ----------------
 
     def execute_search(self, index_expr: str, body: dict,
@@ -123,21 +173,49 @@ class SearchActionService:
                     raise ElasticsearchTpuError(
                         f"all shards failed: no started copy of "
                         f"[{index}][{sid}]")
-                # prefer the local copy (zero hops), else any started one —
-                # adaptive replica selection refines this choice (ref:
-                # OperationRouting.java:34)
-                chosen = next((r for r in copies
-                               if r.node_id == self.shards.node_name),
-                              copies[sid % len(copies)])
+                # adaptive replica selection: the local copy is free; among
+                # remote copies, prefer the node with the best observed
+                # service-time EWMA (ref: OperationRouting.java:34)
+                local = next((r for r in copies
+                              if r.node_id == self.shards.node_name), None)
+                if local is not None:
+                    chosen = local
+                else:
+                    chosen = min(
+                        copies,
+                        key=lambda r: (self._node_ewma_ms.get(
+                            r.node_id, 0.0), r.node_id))
                 targets.append((chosen.node_id, index, sid))
 
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
         sort = parse_sort(body.get("sort"))
 
+        # ---- can_match pre-filter: skip shards that provably hold no
+        # matches (ref: CanMatchPreFilterSearchPhase — only bothers when
+        # there are enough shards for skipping to pay for the round) ----
+        skipped = 0
+        required = self._required_terms(body) if len(targets) > 1 else []
+        if required:
+            kept = []
+            for node, index, sid in targets:
+                try:
+                    r = self.channels.request(
+                        node, ACTION_CAN_MATCH,
+                        {"index": index, "shard_id": sid,
+                         "required_terms": required})
+                    if r.get("can_match", True):
+                        kept.append((node, index, sid))
+                    else:
+                        skipped += 1
+                except Exception:  # noqa: BLE001 — fail open
+                    kept.append((node, index, sid))
+            targets = kept
+
         shard_results: List[dict] = []
         failed = 0
         for node, index, sid in targets:
+            t_q = time.monotonic()
             try:
                 resp = self.channels.request(
                     node, ACTION_QUERY,
@@ -146,8 +224,14 @@ class SearchActionService:
                 resp["_index"] = index
                 resp["_shard"] = sid
                 shard_results.append(resp)
+                took_ms = (time.monotonic() - t_q) * 1000.0
+                prev = self._node_ewma_ms.get(node, took_ms)
+                self._node_ewma_ms[node] = 0.7 * prev + 0.3 * took_ms
             except Exception:  # noqa: BLE001
                 failed += 1
+                # penalize the node so ARS stops preferring a failing copy
+                prev = self._node_ewma_ms.get(node, 0.0)
+                self._node_ewma_ms[node] = 0.7 * prev + 0.3 * 5000.0
 
         # ---- reduce (ref: SearchPhaseController.reducedQueryPhase) ----
         total = sum(r["total"] for r in shard_results)
@@ -234,9 +318,9 @@ class SearchActionService:
         resp = {
             "took": int((time.monotonic() - start) * 1000),
             "timed_out": False,
-            "_shards": {"total": len(targets),
-                        "successful": len(shard_results),
-                        "skipped": 0, "failed": failed},
+            "_shards": {"total": len(targets) + skipped,
+                        "successful": len(shard_results) + skipped,
+                        "skipped": skipped, "failed": failed},
             "hits": {"total": {"value": total, "relation": relation},
                      "max_score": max_score, "hits": hits_out},
         }

@@ -394,7 +394,9 @@ class InternalEngine:
         copies. Serving-snapshot caches key on this (ref: Lucene reader
         version as used by the shard request cache)."""
         with self._lock:
-            return tuple((id(s), self._live_epochs[i])
+            # seg_id is engine-unique and never recycled (unlike id()):
+            # cache keys built from it cannot alias a GC'd segment
+            return tuple((s.seg_id, self._live_epochs[i])
                          for i, s in enumerate(self._segments))
 
     # ---------------- refresh / flush / merge ----------------

@@ -53,6 +53,11 @@ class IndexService:
         from elasticsearch_tpu.search.serving import ServingContext
 
         self.serving = ServingContext(self)
+        # shard request cache (ref: indices/IndicesRequestCache.java:57 —
+        # caches size=0/aggs-only responses keyed on reader version + request)
+        self._req_cache: Dict[tuple, dict] = {}
+        self._req_cache_lock = threading.Lock()
+        self.request_cache_stats = {"hits": 0, "misses": 0}
 
     # ---- document ops ----
 
@@ -89,14 +94,49 @@ class IndexService:
 
     # ---- search (scatter-gather across shards) ----
 
+    _REQ_CACHE_MAX = 64
+
+    def _request_cache_key(self, request: dict, search_type: str):
+        """None when the request is not cacheable. Cacheable = size 0 (the
+        aggregations/count shape the reference caches by default) with no
+        cursor/pit mechanics; the searcher version in the key invalidates
+        on every refresh/delete."""
+        import json as _json
+
+        if int(request.get("size", 10)) != 0 or request.get("search_after")                 is not None or "_after_full" in request                 or request.get("_want_cursor") or request.get("timeout"):
+            return None
+        try:
+            body = _json.dumps(request, sort_keys=True)
+        except (TypeError, ValueError):
+            return None
+        version = tuple(sv for s in self.shards for sv in s.searcher_version())
+        return (version, search_type, body)
+
     def search(self, request: dict, search_type: str = "query_then_fetch",
                searchers=None, task=None) -> dict:
+        import copy as _copy
+
+        key = self._request_cache_key(request, search_type)             if searchers is None else None
+        if key is not None:
+            with self._req_cache_lock:
+                hit = self._req_cache.get(key)
+            if hit is not None:
+                self.request_cache_stats["hits"] += 1
+                return _copy.deepcopy(hit)
+            self.request_cache_stats["misses"] += 1
         if searchers is None:
-            fast = self.serving.try_search(request, search_type, task=task)
-            if fast is not None:
-                return fast
-        return self._search_dense(request, search_type, searchers=searchers,
-                                  task=task)
+            resp = self.serving.try_search(request, search_type, task=task)
+        else:
+            resp = None
+        if resp is None:
+            resp = self._search_dense(request, search_type,
+                                      searchers=searchers, task=task)
+        if key is not None and not resp.get("timed_out"):
+            with self._req_cache_lock:
+                if len(self._req_cache) >= self._REQ_CACHE_MAX:
+                    self._req_cache.pop(next(iter(self._req_cache)))
+                self._req_cache[key] = _copy.deepcopy(resp)
+        return resp
 
     def msearch(self, requests: List[dict],
                 search_type: str = "query_then_fetch") -> List[dict]:

@@ -120,6 +120,17 @@ class KeywordColumn:
 
 
 @dataclass
+class GeoColumn:
+    """Paired lat/lon multivalues (CSR, UNSORTED so index i of lat pairs
+    with index i of lon — per-axis sorting would scramble the points)."""
+
+    lat: np.ndarray                     # [total_points] f64
+    lon: np.ndarray                     # [total_points] f64
+    value_start: np.ndarray             # [n_docs + 1] i64
+    exists: np.ndarray                  # [n_docs] bool
+
+
+@dataclass
 class VectorColumn:
     vectors: np.ndarray                 # [n_docs, dims] f32
     norms: np.ndarray                   # [n_docs] f32
@@ -143,6 +154,7 @@ class Segment:
         vectors: Dict[str, VectorColumn],
         seq_nos: np.ndarray,
         versions: np.ndarray | None = None,
+        geo: Dict[str, "GeoColumn"] | None = None,
     ):
         self.seg_id = seg_id
         self.n_docs = len(doc_ids)
@@ -153,6 +165,7 @@ class Segment:
         self.numeric = numeric
         self.keyword = keyword
         self.vectors = vectors
+        self.geo = geo or {}
         self.seq_nos = seq_nos          # [n_docs] i64 — seqno of each op
         self.versions = versions if versions is not None else np.ones(self.n_docs, np.int64)
         self._device: dict = {}
@@ -166,6 +179,7 @@ class Segment:
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        self.__dict__.setdefault("geo", {})   # pre-geo pickled segments
         self._device = {}
         self._device_lock = threading.Lock()
 
@@ -364,7 +378,10 @@ class SegmentBuilder:
         numeric_fields: dict[str, None] = {}
         keyword_fields: dict[str, None] = {}
         vector_fields: dict[str, None] = {}
+        geo_fields: dict[str, None] = {}
         for d in docs:
+            for f in d.geo:
+                geo_fields[f] = None
             for f in d.inverted:
                 inverted_fields[f] = None
             for f in d.numeric:
@@ -384,6 +401,7 @@ class SegmentBuilder:
         numeric = {f: self._build_numeric(f, docs) for f in numeric_fields}
         keyword = {f: self._build_keyword(f, docs) for f in keyword_fields}
         vectors = {f: self._build_vectors(f, docs) for f in vector_fields}
+        geo = {f: self._build_geo(f, docs) for f in geo_fields}
 
         return Segment(
             seg_id=self.seg_id,
@@ -395,6 +413,7 @@ class SegmentBuilder:
             vectors=vectors,
             seq_nos=np.asarray(self._seq_nos, np.int64),
             versions=np.asarray(self._versions, np.int64),
+            geo=geo,
         )
 
     # ---- builders ----
@@ -489,6 +508,25 @@ class SegmentBuilder:
             doc_len=doc_len,
             sum_doc_len=float(doc_len.sum()),
         )
+
+    def _build_geo(self, fname: str, docs: List[LuceneDoc]) -> "GeoColumn":
+        n = len(docs)
+        exists = np.zeros(n, bool)
+        starts = np.zeros(n + 1, np.int64)
+        lat_parts: List[float] = []
+        lon_parts: List[float] = []
+        for i, d in enumerate(docs):
+            pts = d.geo.get(fname)
+            starts[i] = len(lat_parts)
+            if pts:
+                exists[i] = True
+                for la, lo in pts:
+                    lat_parts.append(la)
+                    lon_parts.append(lo)
+        starts[n] = len(lat_parts)
+        return GeoColumn(lat=np.asarray(lat_parts, np.float64),
+                         lon=np.asarray(lon_parts, np.float64),
+                         value_start=starts, exists=exists)
 
     def _build_numeric(self, fname: str, docs: List[LuceneDoc]) -> NumericColumn:
         n = len(docs)

@@ -1,0 +1,9 @@
+from elasticsearch_tpu.ingest.processors import (
+    DropDocument, IngestDocument, IngestProcessorError, PROCESSORS,
+)
+from elasticsearch_tpu.ingest.service import (
+    IngestService, Pipeline, PipelineMissingError,
+)
+
+__all__ = ["DropDocument", "IngestDocument", "IngestProcessorError",
+           "PROCESSORS", "IngestService", "Pipeline", "PipelineMissingError"]

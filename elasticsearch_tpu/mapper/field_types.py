@@ -218,6 +218,27 @@ class DenseVectorFieldType(FieldType):
         return arr
 
 
+class GeoPointFieldType(FieldType):
+    """lat/lon pairs as TWO dense numeric columns ({field}.lat/{field}.lon —
+    ref: GeoPointFieldMapper; the reference packs into a BKD tree, here
+    distance/box predicates are vectorized column math over the pair, which
+    is the columnar play for spatial filtering on dense hardware)."""
+
+    family = "geo"
+
+    def parse(self, value: Any) -> tuple:
+        from elasticsearch_tpu.search.queries import parse_geo_point
+
+        try:
+            return parse_geo_point(value)
+        except Exception:
+            raise MapperParsingError(
+                f"failed to parse geo_point [{value}] for [{self.name}]")
+
+    def doc_value(self, value):
+        return self.parse(value)
+
+
 _EPOCH = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
 
 
@@ -257,6 +278,7 @@ _TYPES = {
     "boolean": BooleanFieldType,
     "ip": IpFieldType,
     "dense_vector": DenseVectorFieldType,
+    "geo_point": GeoPointFieldType,
 }
 
 

@@ -46,6 +46,8 @@ class LuceneDoc:
     vectors: Dict[str, np.ndarray] = field(default_factory=dict)
     # total token count per text field (field length norm for BM25)
     field_lengths: Dict[str, int] = field(default_factory=dict)
+    # field -> [(lat, lon)] pairs (geo_point columns keep pairing intact)
+    geo: Dict[str, List[Tuple[float, float]]] = field(default_factory=dict)
     # next free position per text field (internal; positions-gap bookkeeping)
     _pos_ceiling: Dict[str, int] = field(default_factory=dict)
 
@@ -140,12 +142,22 @@ class MapperService:
     def _parse_obj(self, prefix: str, obj: dict, doc: LuceneDoc, dyn: Dict[str, FieldType]) -> None:
         for key, value in obj.items():
             full = f"{prefix}{key}"
-            if isinstance(value, dict):
+            known = self._field_types.get(full)
+            if isinstance(value, dict) and not (
+                    known is not None and known.family == "geo"):
                 self._parse_obj(f"{full}.", value, doc, dyn)
                 continue
-            known = self._field_types.get(full)
             if known is not None and known.family == "vector":
                 self._index_values(known, [value], doc)  # whole array is one value
+                continue
+            if known is not None and known.family == "geo":
+                # [lon, lat] is ONE point; a list of dicts/strings/pairs is
+                # multi-valued
+                if isinstance(value, list) and value and \
+                        isinstance(value[0], (dict, str, list, tuple)):
+                    self._index_values(known, list(value), doc)
+                else:
+                    self._index_values(known, [value], doc)
                 continue
             values = value if isinstance(value, list) else [value]
             # nested objects inside arrays are flattened (reference object-array semantics)
@@ -190,6 +202,8 @@ class MapperService:
                     doc.keyword.setdefault(ft.name, []).append(dv)
             elif ft.family == "vector":
                 doc.vectors[ft.name] = ft.doc_value(v)
+            elif ft.family == "geo":
+                doc.geo.setdefault(ft.name, []).append(ft.doc_value(v))
 
     def _dynamic_field_type(self, name: str, values: list, dyn: Dict[str, FieldType]) -> FieldType | None:
         """Dynamic mapping rules (ref: DocumentParser dynamic templates default):

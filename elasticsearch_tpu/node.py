@@ -40,6 +40,9 @@ class Node:
         from elasticsearch_tpu.tasks import TaskManager
 
         self.tasks = TaskManager(self.node_id)
+        from elasticsearch_tpu.ingest import IngestService
+
+        self.ingest = IngestService()
         from elasticsearch_tpu.snapshots import SnapshotsService
 
         self.snapshots = SnapshotsService(

@@ -47,6 +47,7 @@ from elasticsearch_tpu.parallel.spmd import (
 
 HOT_DF_FRACTION = 8     # df > total_docs/8 -> dense column
 PASS_A_BLOCKS = 8       # blocks per query in the theta-estimation pass
+_HOST_CONJ_DF = 1 << 16  # rarest required term below this -> host conjunction
 
 # (block-bucket B, queries per dispatch Qc): lane work per dispatch stays
 # ~bounded (B*128*Qc lanes) so a handful of heavy queries can't inflate the
@@ -340,12 +341,19 @@ class BlockMaxBM25:
             return []
 
         timing["n_queries"] = len(flat)
-        # ---- pass A: fixed small shape, chunked in order ----
+        # ---- pass A: small shape, ADAPTIVE chunk size (a single query must
+        # not pay a 512-query dispatch's padding — its latency is the
+        # product's per-search latency) ----
         t0 = _time.monotonic()
-        qa_b, qa_qc = _GROUP_SHAPES[0][0], _GROUP_SHAPES[0][1]
+        qa_b, qa_max = _GROUP_SHAPES[0][0], _GROUP_SHAPES[0][1]
         a_packed = []
-        for off in range(0, len(flat), qa_qc):
-            chunk = flat[off: off + qa_qc]
+        off = 0
+        while off < len(flat):
+            chunk = flat[off: off + qa_max]
+            off += len(chunk)
+            # two sizes only (8 or the nominal max): every extra (shape)
+            # pair is a fresh XLA compile — keep the program cache tiny
+            qa_qc = max(dp, 8 if len(chunk) <= 8 else qa_max)
             if len(chunk) < qa_qc:
                 chunk = chunk + [chunk[-1]] * (qa_qc - len(chunk))
             W, qb, qi_ = self._assemble(chunk, None, qa_b)
@@ -353,7 +361,7 @@ class BlockMaxBM25:
                 self.stacked.block_docs, self.stacked.block_scores,
                 self.stacked.live, self.hot_cols,
                 jnp.asarray(W), jnp.asarray(qb), jnp.asarray(qi_),
-                mesh=self.mesh, k=k))
+                mesh=self.mesh, k=k, tiebreak=False))
         t1 = _time.monotonic()
         timing["assemble_a"] = t1 - t0
         # one transfer: theta for every query
@@ -380,7 +388,9 @@ class BlockMaxBM25:
                     per_shard[s] += nb
             totals[qi] = per_shard.max()
 
-        groups: Dict[Tuple[int, int], List[int]] = {}
+        # group key: (bucket shape, query-has-hot-terms) — lane-only groups
+        # dispatch a program without the dense matmul / dense top-k
+        groups: Dict[Tuple[Tuple[int, int], bool], List[int]] = {}
         overflow: List[int] = []
         for qi, tot in enumerate(totals):
             if int(tot) > _MAX_BUCKET:
@@ -389,15 +399,22 @@ class BlockMaxBM25:
                 # take the chunked scatter-add path instead
                 overflow.append(qi)
             else:
-                groups.setdefault(_group_shape(int(tot)), []).append(qi)
+                has_hot = any(
+                    (m := self._terms.get(t)) is not None and m.hot_slot >= 0
+                    for t, _ in flat[qi])
+                groups.setdefault((_group_shape(int(tot)), has_hot),
+                                  []).append(qi)
 
         t3 = _time.monotonic()
         pending = []   # (query_indices, packed)
-        for (bucket, qc), members in sorted(groups.items()):
-            qc = max(qc, dp)
-            for off in range(0, len(members), qc):
-                grp = members[off: off + qc]
+        for ((bucket, qc_max), has_hot), members in sorted(groups.items()):
+            for off in range(0, len(members), qc_max):
+                grp = members[off: off + qc_max]
                 idxs = list(grp)
+                # adaptive padding, TWO sizes only: a small tail chunk
+                # dispatches at Qc=8 instead of the nominal size; more size
+                # classes would multiply compiles for marginal padding wins
+                qc = max(dp, 8 if len(grp) <= 8 else qc_max)
                 chunk = [flat[qi] for qi in grp]
                 sels = [selections[qi] for qi in grp]
                 if len(chunk) < qc:
@@ -407,11 +424,18 @@ class BlockMaxBM25:
                 if check is not None:
                     check()
                 W, qb, qi_ = self._assemble(chunk, sels, bucket)
-                packed_b = _hybrid_program(
-                    self.stacked.block_docs, self.stacked.block_scores,
-                    self.stacked.live, self.hot_cols,
-                    jnp.asarray(W), jnp.asarray(qb), jnp.asarray(qi_),
-                    mesh=self.mesh, k=k)
+                if has_hot:
+                    packed_b = _hybrid_program(
+                        self.stacked.block_docs, self.stacked.block_scores,
+                        self.stacked.live, self.hot_cols,
+                        jnp.asarray(W), jnp.asarray(qb), jnp.asarray(qi_),
+                        mesh=self.mesh, k=k)
+                else:
+                    packed_b = _lane_program(
+                        self.stacked.block_docs, self.stacked.block_scores,
+                        self.stacked.live,
+                        jnp.asarray(qb), jnp.asarray(qi_),
+                        mesh=self.mesh, k=k)
                 pending.append((idxs, packed_b))
         t4 = _time.monotonic()
         timing["assemble_dispatch_b"] = t4 - t3
@@ -517,17 +541,27 @@ class BlockMaxBM25:
         terms contribute through the dense column matmul, with a presence
         matmul (Wp @ (col>0)) supplying their coverage counts.
 
-        Returns (scores [Q,k], shard [Q,k], ord [Q,k]), doc-id tie-break."""
+        Returns (scores [Q,k], shard [Q,k], ord [Q,k]), doc-id tie-break.
+
+        Executor choice per query mirrors Lucene's lead-cost logic: when the
+        rarest REQUIRED term is selective (df <= _HOST_CONJ_DF), candidate
+        sets are tiny and a host sparse intersection beats shipping every
+        block to the device by orders of magnitude; heavy conjunctions
+        (stopword-grade musts) go to the device program where the dense
+        matmul amortizes."""
         Q = len(queries)
         out = np.zeros((Q, 3, k), np.float32)
         specs = []
         totals = np.zeros(Q, np.int64)
+        host_path: List[int] = []
         for qi_, spec in enumerate(queries):
             must = [(t, b, True) for t, b in spec.get("must", ())]
             must += [(t, 0.0, True) for t in spec.get("filter", ())]
             should = [(t, b, False) for t, b in spec.get("should", ())]
             rows = []
             nm = 0
+            n_req_present = 0
+            min_req_df = None
             per_shard = np.zeros(max(self.S, 1), np.int64)
             for t, b, required in must + should:
                 m = self._term_meta(t)
@@ -536,15 +570,32 @@ class BlockMaxBM25:
                 if m is None:
                     continue
                 rows.append((t, b, required, m))
+                if required:
+                    n_req_present += 1
+                    df = sum(len(m.blocks[s].docs) for s in range(self.S))
+                    min_req_df = df if min_req_df is None else min(min_req_df, df)
                 if m.hot_slot < 0:
                     for s in range(self.S):
                         per_shard[s] += len(m.blocks[s].ids)
             specs.append((rows, nm))
             totals[qi_] = per_shard.max()
+            if nm > n_req_present:
+                # a required term is missing globally: provably empty
+                continue
+            if nm > 0 and (min_req_df or 0) <= _HOST_CONJ_DF:
+                host_path.append(qi_)
 
+        for qi_ in host_path:
+            out[qi_] = self._bool_host(*specs[qi_], k)
+
+        host_set = set(host_path)
         groups: Dict[Tuple[int, int], List[int]] = {}
         overflow: List[int] = []
         for qi_, tot in enumerate(totals):
+            rows, nm = specs[qi_]
+            if qi_ in host_set or nm > sum(
+                    1 for _, _, req, _ in rows if req):
+                continue
             if int(tot) > _MAX_BUCKET:
                 overflow.append(qi_)
             else:
@@ -596,6 +647,72 @@ class BlockMaxBM25:
                     mesh=self.mesh, k=k)
                 out[grp] = np.asarray(packed)[: len(grp)]
         return out[:, 0], out[:, 1].view(np.int32), out[:, 2].view(np.int32)
+
+    def _host_bs(self, s: int) -> np.ndarray:
+        cache = getattr(self, "_host_bs_cache", None)
+        if cache is None:
+            cache = self._host_bs_cache = {}
+        if s not in cache:
+            cache[s] = _host_block_scores(self.stacked.postings[s],
+                                          self.stacked.avgdl)
+        return cache[s]
+
+    def _term_impacts(self, m: _TermMeta, s: int) -> np.ndarray:
+        """Per-posting idf-free impact scores aligned with blocks[s].docs."""
+        sb = m.blocks[s]
+        if sb.scores is None:
+            bs = self._host_bs(s)
+            sb.scores = bs[sb.ids].ravel()[: len(sb.docs)]
+        return sb.scores
+
+    def _bool_host(self, rows, nm: int, k: int) -> np.ndarray:
+        """Selective conjunction on host: sorted-posting intersection of the
+        required terms, vectorized score lookups for every clause (the
+        sparse analog of Lucene's ConjunctionDISI + WANDScorer lead-cost
+        iteration). Exact; cost O(df of the rarest required term)."""
+        cand_out: List[Tuple[float, int, int]] = []
+        lh = self.stacked.live_host
+        for s in range(self.S):
+            req = [m.blocks[s].docs for _, _, r, m in rows if r]
+            if any(len(docs) == 0 for docs in req) or not req:
+                continue
+            req.sort(key=len)
+            cand = req[0]
+            for docs in req[1:]:
+                cand = cand[np.isin(cand, docs, assume_unique=True)]
+                if not len(cand):
+                    break
+            if not len(cand):
+                continue
+            if lh is not None and not lh[s].all():
+                cand = cand[lh[s][cand]]
+                if not len(cand):
+                    continue
+            scores = np.zeros(len(cand), np.float64)
+            for t, b, req_, m in rows:
+                sb = m.blocks[s]
+                if not len(sb.docs):
+                    continue
+                imp = self._term_impacts(m, s)
+                j = np.searchsorted(sb.docs, cand)
+                present = j < len(sb.docs)
+                present[present] = sb.docs[j[present]] == cand[present]
+                w = m.idf * b
+                scores += np.where(present, w * imp[np.minimum(j, len(imp) - 1)], 0.0)
+            keep = scores > 0
+            cand, scores = cand[keep], scores[keep]
+            if len(cand) > k:
+                sel = np.lexsort((cand, -scores))[:k]
+                cand, scores = cand[sel], scores[sel]
+            cand_out.extend((float(scores[i]), s, int(cand[i]))
+                            for i in range(len(cand)))
+        cand_out.sort(key=lambda x: (-x[0], x[1], x[2]))
+        packed = np.zeros((3, k), np.float32)
+        for j, (sc, s, d) in enumerate(cand_out[:k]):
+            packed[0, j] = sc
+            packed[1, j] = np.int32(s).view(np.float32)
+            packed[2, j] = np.int32(d).view(np.float32)
+        return packed
 
     def _bool_exhaustive(self, rows, nm: int, k: int) -> np.ndarray:
         """Host fallback for block-heavy bool queries (> _MAX_BUCKET blocks
@@ -730,7 +847,27 @@ def _host_block_scores(fp, avgdl: float) -> np.ndarray:
 # --------------------------------------------------------------------------
 
 
-def _one_query_topk(d, s, dense, live, k):
+def _lane_candidates(d, s, extra_per_doc, live, k, tiebreak):
+    """Lane path: segmented-run totals over sorted (doc, score) lanes ->
+    top-k candidates. extra_per_doc is the per-doc hot/dense addend (None
+    for lane-only queries). tiebreak=False uses plain top_k — for theta
+    estimation, where any k-th value is a valid lower bound."""
+    order = jnp.argsort(d)
+    d = jnp.take(d, order)
+    s = jnp.take(s, order)
+    tot = _segmented_run_sums(d, s)
+    is_last = jnp.concatenate([d[1:] != d[:-1], jnp.ones(1, bool)])
+    lane_tot = tot if extra_per_doc is None else tot + jnp.take(extra_per_doc, d)
+    ok = is_last & (tot > 0) & jnp.take(live, d)
+    masked = jnp.where(ok, lane_tot, -jnp.inf)
+    if tiebreak:
+        neg2, d2 = jax.lax.sort((-masked, d), num_keys=2)
+        return -neg2[:k], d2[:k]
+    top_s, idx = jax.lax.top_k(masked, k)
+    return top_s, jnp.take(d, idx)
+
+
+def _one_query_topk(d, s, dense, live, k, tiebreak=True):
     """Exact top-k for one query on one shard.
 
     d [L] lane doc ids (concatenated kept blocks), s [L] lane scores
@@ -743,19 +880,12 @@ def _one_query_topk(d, s, dense, live, k):
     cand1; docs with sparse lanes are exact in cand2; the merge dedups by doc
     keeping the max, which is always the exact variant.
     """
-    order = jnp.argsort(d)
-    d = jnp.take(d, order)
-    s = jnp.take(s, order)
-    tot = _segmented_run_sums(d, s)
-    is_last = jnp.concatenate([d[1:] != d[:-1], jnp.ones(1, bool)])
-    lane_tot = tot + jnp.take(dense, d)
-    ok = is_last & (tot > 0) & jnp.take(live, d)
-    # lane candidates ranked by (score desc, doc asc) — doc-id tie-break
-    neg2, cand2_d = jax.lax.sort(
-        (-jnp.where(ok, lane_tot, -jnp.inf), d), num_keys=2)
-    cand2_s, cand2_d = -neg2[:k], cand2_d[:k]
-    cand1_s, cand1_d = _dense_topk_tiebreak(
-        jnp.where(live & (dense > 0), dense, -jnp.inf), k)
+    cand2_s, cand2_d = _lane_candidates(d, s, dense, live, k, tiebreak)
+    dense_masked = jnp.where(live & (dense > 0), dense, -jnp.inf)
+    if tiebreak:
+        cand1_s, cand1_d = _dense_topk_tiebreak(dense_masked, k)
+    else:
+        cand1_s, cand1_d = jax.lax.top_k(dense_masked, k)
     ms = jnp.concatenate([cand1_s, cand2_s])
     md = jnp.concatenate([cand1_d.astype(jnp.int32), cand2_d])
     # dedup by doc, keeping the best score: order by (doc asc, score desc)
@@ -913,14 +1043,16 @@ def _bool_program(block_docs, block_scores, live, hot_cols, W, Wp, qb, qi, qf,
     return program(block_docs, block_scores, live, hot_cols, W, Wp, qb, qi, qf, nm)
 
 
-@partial(jax.jit, static_argnames=("mesh", "k"))
+@partial(jax.jit, static_argnames=("mesh", "k", "tiebreak"))
 def _hybrid_program(block_docs, block_scores, live, hot_cols, W, qblocks, qidf,
-                    *, mesh, k):
+                    *, mesh, k, tiebreak=True):
     """dense hot-matmul + sparse culled blocks -> exact merged top-k.
 
     Shapes: block_docs/scores [S,T,128], live [S,D], hot_cols [S,H,D],
     W [Q,H], qblocks/qidf [Q,S,B]. Output packed [Q,3,k] f32 (score, shard,
-    ord bitcast) — one transfer per batch.
+    ord bitcast) — one transfer per batch. tiebreak=False (pass A / theta)
+    skips the doc-id tie-break machinery: a theta lower bound does not care
+    which of several tied docs ranks k-th.
     """
 
     @partial(
@@ -946,7 +1078,9 @@ def _hybrid_program(block_docs, block_scores, live, hot_cols, W, qblocks, qidf,
             d2 = docs.reshape(Qc, -1)
             s2 = sc.reshape(Qc, -1)
             return jax.vmap(
-                lambda d, s, dn: _one_query_topk(d, s, dn, lv, k))(d2, s2, dense)
+                lambda d, s, dn: _one_query_topk(d, s, dn, lv, k,
+                                                 tiebreak=tiebreak))(
+                d2, s2, dense)
 
         s_scores, s_ords = jax.vmap(
             one_part, in_axes=(0, 0, 0, 0, 1, 1))(
@@ -959,3 +1093,39 @@ def _hybrid_program(block_docs, block_scores, live, hot_cols, W, qblocks, qidf,
              jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
 
     return program(block_docs, block_scores, live, hot_cols, W, qblocks, qidf)
+
+
+@partial(jax.jit, static_argnames=("mesh", "k"))
+def _lane_program(block_docs, block_scores, live, qblocks, qidf, *, mesh, k):
+    """Pass-B variant for query groups with NO hot terms: skips the dense
+    [Qc, D] matmul and the dense top-k entirely — for Zipf-tail query mixes
+    this removes the dominant O(Qc*D) term from most dispatches."""
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("shard"), P("shard"), P("shard"),
+                  P("dp", "shard"), P("dp", "shard")),
+        out_specs=P("dp"),
+        check_vma=False,
+    )
+    def program(block_docs, block_scores, live, qb, qi):
+        def one_part(bd, bs, lv, qb1, qi1):
+            docs = jnp.take(bd, qb1, axis=0)
+            sc = qi1[:, :, None] * jnp.take(bs, qb1, axis=0)
+            Qc = qb1.shape[0]
+            return jax.vmap(
+                lambda d, s: _lane_candidates(d, s, None, lv, k, True))(
+                docs.reshape(Qc, -1), sc.reshape(Qc, -1))
+
+        s_scores, s_ords = jax.vmap(
+            one_part, in_axes=(0, 0, 0, 1, 1))(
+            block_docs, block_scores, live, qb, qi)
+        top_s, shard_of, ord_of = _merge_gathered(
+            _gather_parts(s_scores), _gather_parts(s_ords), k)
+        return jnp.stack(
+            [top_s,
+             jax.lax.bitcast_convert_type(shard_of, jnp.float32),
+             jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
+
+    return program(block_docs, block_scores, live, qblocks, qidf)

@@ -77,6 +77,9 @@ class StackedBM25:
     avgdl: float                # global average doc length
     total_docs: int             # global doc count (idf denominator)
     postings: List[FieldPostings]  # host metadata per shard (term -> blocks)
+    live_host: List[np.ndarray] | None = None  # host copies of the live masks
+    #   (selective-conjunction host path filters candidates without a
+    #   device round trip)
     block_max_scores: List[np.ndarray] | None = None  # host [T_s] per shard:
     #   max idf-free lane score per block — the block-max culling metadata
     #   (SURVEY §5.7: the BlockMaxWAND analog's skip data)
@@ -175,6 +178,7 @@ def build_stacked_bm25(
         avgdl=float(avgdl),
         total_docs=total_docs,
         postings=fps,
+        live_host=live_np,
         block_max_scores=block_max_scores,
     )
 

@@ -83,6 +83,14 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("DELETE", "/_search/scroll", h.scroll_clear)
     r("POST", "/{index}/_pit", h.open_pit)
     r("DELETE", "/_pit", h.close_pit)
+    # ingest pipelines (ref: RestPutPipelineAction, RestSimulatePipelineAction)
+    r("PUT", "/_ingest/pipeline/{id}", h.put_pipeline)
+    r("GET", "/_ingest/pipeline/{id}", h.get_pipeline)
+    r("GET", "/_ingest/pipeline", h.get_pipelines)
+    r("DELETE", "/_ingest/pipeline/{id}", h.delete_pipeline)
+    r("POST", "/_ingest/pipeline/{id}/_simulate", h.simulate_pipeline)
+    r("GET", "/_ingest/pipeline/{id}/_simulate", h.simulate_pipeline)
+    r("POST", "/_ingest/pipeline/_simulate", h.simulate_pipeline)
     # snapshots (ref: RestPutRepositoryAction, RestCreateSnapshotAction,
     # RestRestoreSnapshotAction, RestDeleteSnapshotAction)
     r("PUT", "/_snapshot/{repo}", h.put_repository)
@@ -270,7 +278,12 @@ class _Handlers:
         if req.param("if_seq_no") is not None:
             kw["if_seq_no"] = req.param_int("if_seq_no")
             kw["if_primary_term"] = req.param_int("if_primary_term")
-        result = svc.index_doc(doc_id, req.body or {}, op_type=op_type, **kw)
+        source = self._run_pipeline(name, doc_id, req.body or {},
+                                    req.param("pipeline"))
+        if source is None:   # dropped by the pipeline
+            return _ok({"_index": name, "_id": doc_id, "result": "noop",
+                        "_shards": {"total": 0, "successful": 0, "failed": 0}})
+        result = svc.index_doc(doc_id, source, op_type=op_type, **kw)
         if req.param("refresh") in ("true", "", "wait_for"):
             svc.refresh()
         status = 201 if result.result == "created" else 200
@@ -410,6 +423,13 @@ class _Handlers:
                         import uuid as _uuid
 
                         doc_id = _uuid.uuid4().hex[:20]
+                    source = self._run_pipeline(
+                        index, doc_id, source,
+                        meta.get("pipeline", req.param("pipeline")))
+                    if source is None:   # dropped by the pipeline
+                        items.append({op: {"_index": index, "_id": doc_id,
+                                           "result": "noop", "status": 200}})
+                        continue
                     result = svc.index_doc(doc_id, source,
                                            op_type="create" if op == "create" else "index")
                     items.append({op: {**self._write_response(index, result),
@@ -514,6 +534,45 @@ class _Handlers:
         body = dict(req.body or {})
         ok = self.node.indices.close_pit(body.get("id", ""))
         return _ok({"succeeded": ok, "num_freed": int(ok)})
+
+    # ---------- ingest ----------
+
+    def _run_pipeline(self, index: str, doc_id: str, source: dict,
+                      pipeline_param):
+        """Apply ?pipeline= or the index's default_pipeline; None means
+        the document was DROPPED (ref: IngestService drop handling)."""
+        pid = pipeline_param
+        if pid is None and self.node.indices.has(index):
+            meta = self.node.indices.get(index).meta
+            pid = meta.settings.raw("index.default_pipeline")
+        if not pid or pid == "_none":
+            return source
+        return self.node.ingest.process(pid, source, index=index,
+                                        doc_id=doc_id or "")
+
+    def put_pipeline(self, req: RestRequest) -> RestResponse:
+        self.node.ingest.put_pipeline(req.param("id"), dict(req.body or {}))
+        return _ok({"acknowledged": True})
+
+    def get_pipeline(self, req: RestRequest) -> RestResponse:
+        p = self.node.ingest.get_pipeline(req.param("id"))
+        return _ok({p.id: p.body})
+
+    def get_pipelines(self, req: RestRequest) -> RestResponse:
+        return _ok(self.node.ingest.pipelines())
+
+    def delete_pipeline(self, req: RestRequest) -> RestResponse:
+        self.node.ingest.delete_pipeline(req.param("id"))
+        return _ok({"acknowledged": True})
+
+    def simulate_pipeline(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        if req.param("id"):
+            pipeline_body = self.node.ingest.get_pipeline(req.param("id")).body
+        else:
+            pipeline_body = body.get("pipeline", {})
+        docs = self.node.ingest.simulate(pipeline_body, body.get("docs", []))
+        return _ok({"docs": docs})
 
     # ---------- snapshots ----------
 

@@ -46,6 +46,70 @@ B = 0.75
 MAX_TERM_EXPANSIONS = 1024  # ref: index.max_terms_count / MultiTermQuery rewrites
 
 
+def within_edits(a: str, b: str, max_d: int) -> bool:
+    """Optimal-string-alignment distance <= max_d (the reference's fuzzy
+    semantics: Damerau-Levenshtein with adjacent transpositions; ref:
+    Lucene LevenshteinAutomata). Banded DP, early exit."""
+    la, lb = len(a), len(b)
+    if abs(la - lb) > max_d:
+        return False
+    if max_d == 0:
+        return a == b
+    prev2 = None
+    prev = list(range(lb + 1))
+    for i in range(1, la + 1):
+        cur = [i] + [0] * lb
+        row_min = i
+        for j in range(1, lb + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            v = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + cost)
+            if (prev2 is not None and i > 1 and j > 1
+                    and a[i - 1] == b[j - 2] and a[i - 2] == b[j - 1]):
+                v = min(v, prev2[j - 2] + 1)
+            cur[j] = v
+            row_min = min(row_min, v)
+        if row_min > max_d:
+            return False
+        prev2, prev = prev, cur
+    return prev[lb] <= max_d
+
+
+def expand_fuzzy(dictionary, value: str, max_edits: int, prefix_length: int,
+                 max_expansions: int, check=None):
+    """Dictionary terms within max_edits of value (sharing the required
+    prefix), nearest-first, capped at max_expansions."""
+    prefix = value[:prefix_length]
+    out = []
+    for i, t in enumerate(dictionary):
+        if check is not None and i % 65536 == 0:
+            check()
+        if prefix and not t.startswith(prefix):
+            continue
+        if within_edits(t, value, max_edits):
+            d = 0 if t == value else (1 if within_edits(t, value, 1) else 2)
+            out.append((d, t))
+    out.sort()
+    return [t for _, t in out[:max_expansions]]
+
+
+def _haversine_m(lat, lon, qlat, qlon) -> np.ndarray:
+    """Great-circle distance in meters, vectorized (ref: GeoUtils haversin)."""
+    r = 6371008.8
+    lat1, lon1 = np.radians(lat), np.radians(lon)
+    lat2, lon2 = np.radians(qlat), np.radians(qlon)
+    dlat = lat2 - lat1
+    dlon = lon2 - lon1
+    h = np.sin(dlat / 2.0) ** 2 + np.cos(lat1) * np.cos(lat2) * np.sin(dlon / 2.0) ** 2
+    return 2.0 * r * np.arcsin(np.minimum(np.sqrt(h), 1.0))
+
+
+def _any_per_doc(col, hit: np.ndarray) -> np.ndarray:
+    """CSR 'any value matches' reduction over a NumericColumn's multivalues."""
+    cum = np.concatenate([[0], np.cumsum(hit.astype(np.int64))])
+    counts = cum[col.value_start[1:]] - cum[col.value_start[:-1]]
+    return (counts > 0) & col.exists
+
+
 class ShardStats:
     """Shard-wide collection statistics for consistent BM25 across segments."""
 
@@ -154,7 +218,7 @@ class QueryExecutor:
     def _exec_TermQuery(self, query, leaf):
         return self._term_scores(leaf, query.field, str(query.value))
 
-    def _exec_TermsQuery(self, query, leaf):
+    def _impl_TermsQuery(self, query, leaf):
         """Constant-score disjunction (ref: Lucene TermInSetQuery)."""
         field = query.field
         ft = self.mapper.field_type(field)
@@ -247,7 +311,7 @@ class QueryExecutor:
         scores = jnp.asarray(scores_np)
         return scores, scores > 0
 
-    def _exec_RangeQuery(self, query, leaf):
+    def _impl_RangeQuery(self, query, leaf):
         field = query.field
         ft = self.mapper.field_type(field)
         if ft is not None and ft.family == "numeric":
@@ -284,7 +348,7 @@ class QueryExecutor:
             hi_i = bisect.bisect_left(terms, str(query.lt))
         return self._terms_mask_by_ords(leaf, field, range(lo_i, max(lo_i, hi_i)))
 
-    def _exec_ExistsQuery(self, query, leaf):
+    def _impl_ExistsQuery(self, query, leaf):
         field = query.field
         seg = leaf.segment
         mask_np = np.zeros(leaf.n_docs, bool)
@@ -316,10 +380,104 @@ class QueryExecutor:
         mask = jnp.asarray(mask_np)
         return mask.astype(jnp.float32), mask
 
-    def _exec_PrefixQuery(self, query, leaf):
+    def _impl_PrefixQuery(self, query, leaf):
         return self._multi_term(leaf, query.field, lambda t: t.startswith(query.value))
 
-    def _exec_WildcardQuery(self, query, leaf):
+    def _exec_FuzzyQuery(self, query, leaf):
+        """Edit-distance expansion over the term dictionary; each doc scores
+        as its best-matching expansion (ref: Lucene FuzzyQuery via
+        top-terms blended rewrite — best-of approximates the blend)."""
+        fp = leaf.segment.postings.get(query.field)
+        if fp is None:
+            return self._none(leaf)
+        terms = expand_fuzzy(fp.terms, query.value, query.max_edits(),
+                             query.prefix_length, query.max_expansions,
+                             check=self.check)
+        if not terms:
+            return self._none(leaf)
+        scores = jnp.zeros(leaf.n_docs, jnp.float32)
+        mask = jnp.zeros(leaf.n_docs, bool)
+        for t in terms:
+            s, m = self._term_scores(leaf, query.field, t)
+            scores = jnp.maximum(scores, s)
+            mask = mask | m
+        return scores, mask
+
+    def _impl_RegexpQuery(self, query, leaf):
+        """Anchored regular expression over the term dictionary (ref:
+        RegexpQueryBuilder — Lucene RegExp is implicitly anchored)."""
+        import re
+
+        try:
+            pat = re.compile(query.value)
+        except re.error as e:
+            raise IllegalArgumentError(f"invalid regexp [{query.value}]: {e}")
+        return self._multi_term(leaf, query.field,
+                                lambda t: pat.fullmatch(t) is not None)
+
+    def _exec_MatchPhrasePrefixQuery(self, query, leaf):
+        """Phrase with the LAST term prefix-expanded (ref:
+        MatchPhrasePrefixQueryBuilder -> Lucene MultiPhraseQuery): phrase
+        frequency sums over the expansions, scored BM25 with the fixed
+        terms' idf plus an idf from the expansions' combined df."""
+        ft = self.mapper.field_type(query.field)
+        if ft is None or ft.family != "inverted":
+            return self._none(leaf)
+        analyzer = self.mapper.analyzer_for(ft)
+        terms = analyzer.terms(query.text)
+        if not terms:
+            return self._none(leaf)
+        fp = leaf.segment.postings.get(query.field)
+        if fp is None:
+            return self._none(leaf)
+        prefix = terms[-1]
+        fixed = terms[:-1]
+        expansions = [t for t in fp.terms if t.startswith(prefix)]
+        expansions = expansions[: query.max_expansions]
+        if not expansions:
+            return self._none(leaf)
+        pf_total = np.zeros(leaf.n_docs, np.float32)
+        for exp in expansions:
+            if self.check is not None:
+                self.check()
+            docs, pf = phrase_freqs(fp, fixed + [exp], slop=query.slop)
+            if len(docs):
+                pf_total[docs] += pf
+        if not pf_total.any():
+            return self._none(leaf)
+        df_union = sum(self.stats.df(query.field, t) for t in expansions)
+        idf_sum = sum(self.stats.idf(query.field, t) for t in fixed)
+        idf_sum += bm25_idf(self.stats.doc_count, min(df_union, self.stats.doc_count))
+        avgdl = self.stats.avgdl(query.field)
+        denom = pf_total + K1 * (1.0 - B + B * fp.doc_len / max(avgdl, 1e-9))
+        scores_np = np.where(pf_total > 0,
+                             idf_sum * pf_total * (K1 + 1.0) / denom,
+                             0.0).astype(np.float32)
+        scores = jnp.asarray(scores_np)
+        return scores, scores > 0
+
+    def _impl_GeoDistanceQuery(self, query, leaf):
+        gc = leaf.segment.geo.get(query.field)
+        if gc is None:
+            return self._none(leaf)
+        d = _haversine_m(gc.lat, gc.lon, query.lat, query.lon)
+        mask = jnp.asarray(_any_per_doc(gc, d <= query.distance_m))
+        return mask.astype(jnp.float32), mask
+
+    def _impl_GeoBoundingBoxQuery(self, query, leaf):
+        gc = leaf.segment.geo.get(query.field)
+        if gc is None:
+            return self._none(leaf)
+        lat, lon = gc.lat, gc.lon
+        ok_lat = (lat <= query.top) & (lat >= query.bottom)
+        if query.left <= query.right:
+            ok_lon = (lon >= query.left) & (lon <= query.right)
+        else:   # box crosses the antimeridian
+            ok_lon = (lon >= query.left) | (lon <= query.right)
+        mask = jnp.asarray(_any_per_doc(gc, ok_lat & ok_lon))
+        return mask.astype(jnp.float32), mask
+
+    def _impl_WildcardQuery(self, query, leaf):
         return self._multi_term(leaf, query.field,
                                 lambda t, pat=query.value: fnmatch.fnmatchcase(t, pat))
 
@@ -396,7 +554,69 @@ class QueryExecutor:
         scores = jnp.where(mask, scores, 0.0)
         return scores, mask
 
+    # constant-score filters: masks cached per segment (see _cached_mask)
+
+    def _exec_TermsQuery(self, query, leaf):
+        mask = self._cached_mask(
+            leaf, query, lambda: self._impl_TermsQuery(query, leaf)[1])
+        return mask.astype(jnp.float32), mask
+
+    def _exec_RangeQuery(self, query, leaf):
+        mask = self._cached_mask(
+            leaf, query, lambda: self._impl_RangeQuery(query, leaf)[1])
+        return mask.astype(jnp.float32), mask
+
+    def _exec_ExistsQuery(self, query, leaf):
+        mask = self._cached_mask(
+            leaf, query, lambda: self._impl_ExistsQuery(query, leaf)[1])
+        return mask.astype(jnp.float32), mask
+
+    def _exec_PrefixQuery(self, query, leaf):
+        mask = self._cached_mask(
+            leaf, query, lambda: self._impl_PrefixQuery(query, leaf)[1])
+        return mask.astype(jnp.float32), mask
+
+    def _exec_WildcardQuery(self, query, leaf):
+        mask = self._cached_mask(
+            leaf, query, lambda: self._impl_WildcardQuery(query, leaf)[1])
+        return mask.astype(jnp.float32), mask
+
+    def _exec_RegexpQuery(self, query, leaf):
+        mask = self._cached_mask(
+            leaf, query, lambda: self._impl_RegexpQuery(query, leaf)[1])
+        return mask.astype(jnp.float32), mask
+
+    def _exec_GeoDistanceQuery(self, query, leaf):
+        mask = self._cached_mask(
+            leaf, query, lambda: self._impl_GeoDistanceQuery(query, leaf)[1])
+        return mask.astype(jnp.float32), mask
+
+    def _exec_GeoBoundingBoxQuery(self, query, leaf):
+        mask = self._cached_mask(
+            leaf, query, lambda: self._impl_GeoBoundingBoxQuery(query, leaf)[1])
+        return mask.astype(jnp.float32), mask
+
     # ---- helpers ----
+
+    _QUERY_CACHE_MAX = 32   # cached filter masks per segment (FIFO)
+
+    def _cached_mask(self, leaf, query, builder):
+        """Per-SEGMENT filter-mask cache (ref: indices/IndicesQueryCache.java
+        :42 — Lucene caches filter DocIdSets per reader). Masks depend only
+        on the immutable segment (live/stats are applied later), so the key
+        is the query's canonical repr; storage rides the segment's device-
+        array cache and dies with the segment."""
+        cache = leaf.segment._device
+        key = f"qcache:{query!r}"
+        hit = cache.get(key)
+        if hit is not None:
+            return hit
+        mask = builder()
+        keys = [k for k in cache if k.startswith("qcache:")]
+        if len(keys) >= self._QUERY_CACHE_MAX:
+            cache.pop(keys[0], None)
+        cache[key] = mask
+        return mask
 
     def _none(self, leaf):
         n = leaf.n_docs

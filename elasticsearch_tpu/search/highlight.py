@@ -66,6 +66,27 @@ def extract_matchers(query, mapper) -> Dict[str, FieldMatchers]:
         elif isinstance(node, q.PrefixQuery):
             fm(node.field).predicates.append(
                 lambda t, p=str(node.value): t.startswith(p))
+        elif isinstance(node, q.FuzzyQuery):
+            from elasticsearch_tpu.search.executor import within_edits
+
+            fm(node.field).predicates.append(
+                lambda t, v=str(node.value), d=node.max_edits():
+                within_edits(t, v, d))
+        elif isinstance(node, q.RegexpQuery):
+            import re
+
+            try:
+                pat = re.compile(node.value)
+                fm(node.field).predicates.append(
+                    lambda t, p=pat: p.fullmatch(t) is not None)
+            except re.error:
+                pass
+        elif isinstance(node, q.MatchPhrasePrefixQuery):
+            terms = analyze(node.field, node.text)
+            if terms:
+                fm(node.field).terms.update(terms[:-1])
+                fm(node.field).predicates.append(
+                    lambda t, p=terms[-1]: t.startswith(p))
         elif isinstance(node, q.WildcardQuery):
             fm(node.field).predicates.append(
                 lambda t, p=str(node.value): fnmatch.fnmatchcase(t, p))

@@ -117,6 +117,92 @@ class BoolQuery(Query):
 
 
 @dataclass
+class FuzzyQuery(Query):
+    field: str
+    value: str
+    fuzziness: object = "AUTO"      # "AUTO" | 0 | 1 | 2
+    prefix_length: int = 0
+    max_expansions: int = 50
+    boost: float = 1.0
+
+    def max_edits(self) -> int:
+        """ref: Fuzziness.AUTO — 0 edits below 3 chars, 1 below 6, else 2."""
+        if isinstance(self.fuzziness, str) and self.fuzziness.upper() == "AUTO":
+            n = len(self.value)
+            return 0 if n < 3 else (1 if n < 6 else 2)
+        return int(self.fuzziness)
+
+
+@dataclass
+class RegexpQuery(Query):
+    field: str
+    value: str
+    boost: float = 1.0
+
+
+@dataclass
+class MatchPhrasePrefixQuery(Query):
+    field: str
+    text: str
+    slop: int = 0
+    max_expansions: int = 50
+    boost: float = 1.0
+
+
+@dataclass
+class GeoDistanceQuery(Query):
+    field: str
+    lat: float
+    lon: float
+    distance_m: float
+    boost: float = 1.0
+
+
+@dataclass
+class GeoBoundingBoxQuery(Query):
+    field: str
+    top: float
+    left: float
+    bottom: float
+    right: float
+    boost: float = 1.0
+
+
+def parse_geo_point(value) -> tuple:
+    """{lat, lon} | 'lat,lon' | [lon, lat] (GeoJSON order) -> (lat, lon).
+    One parser for query AND index time (GeoPointFieldType delegates here)
+    so accepted formats cannot drift."""
+    try:
+        if isinstance(value, dict):
+            return float(value["lat"]), float(value["lon"])
+        if isinstance(value, str):
+            parts = value.split(",")
+            if len(parts) == 2:
+                return float(parts[0]), float(parts[1])
+        elif isinstance(value, (list, tuple)) and len(value) == 2:
+            return float(value[1]), float(value[0])
+    except (KeyError, TypeError, ValueError):
+        pass
+    raise ParsingError(f"failed to parse geo point [{value}]")
+
+
+_DIST_UNITS_M = {"mm": 0.001, "cm": 0.01, "m": 1.0, "km": 1000.0,
+                 "mi": 1609.344, "miles": 1609.344, "yd": 0.9144,
+                 "ft": 0.3048, "in": 0.0254, "nmi": 1852.0, "nm": 1852.0}
+
+
+def parse_distance_m(value) -> float:
+    """'10km' / '500m' / '1.5mi' / number (meters) -> meters."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip().lower()
+    for unit in sorted(_DIST_UNITS_M, key=len, reverse=True):
+        if s.endswith(unit):
+            return float(s[: -len(unit)]) * _DIST_UNITS_M[unit]
+    return float(s)
+
+
+@dataclass
 class KnnQuery(Query):
     """Top-level knn search section (ES 8 _search "knn" or query vector)."""
 
@@ -175,7 +261,7 @@ def parse_query(body: dict) -> Query:
                               boost=v.get("boost", 1.0), fuzziness=v.get("fuzziness"))
         return MatchQuery(fname, str(v))
 
-    if kind in ("match_phrase", "match_phrase_prefix"):
+    if kind == "match_phrase":
         fname, v = _one_entry(spec, kind)
         if isinstance(v, dict):
             return MatchPhraseQuery(fname, str(v["query"]), slop=int(v.get("slop", 0)),
@@ -267,6 +353,55 @@ def parse_query(body: dict) -> Query:
                         num_candidates=int(spec.get("num_candidates", 100)),
                         filter=parse_query(spec["filter"]) if spec.get("filter") else None,
                         boost=spec.get("boost", 1.0))
+
+    if kind == "fuzzy":
+        fname, v = _one_entry(spec, "fuzzy")
+        if not isinstance(v, dict):
+            v = {"value": v}
+        return FuzzyQuery(fname, str(v["value"]),
+                          fuzziness=v.get("fuzziness", "AUTO"),
+                          prefix_length=int(v.get("prefix_length", 0)),
+                          max_expansions=int(v.get("max_expansions", 50)),
+                          boost=v.get("boost", 1.0))
+
+    if kind == "regexp":
+        fname, v = _one_entry(spec, "regexp")
+        if not isinstance(v, dict):
+            v = {"value": v}
+        return RegexpQuery(fname, str(v["value"]), boost=v.get("boost", 1.0))
+
+    if kind == "match_phrase_prefix":
+        fname, v = _one_entry(spec, "match_phrase_prefix")
+        if isinstance(v, dict):
+            return MatchPhrasePrefixQuery(
+                fname, str(v["query"]), slop=int(v.get("slop", 0)),
+                max_expansions=int(v.get("max_expansions", 50)),
+                boost=v.get("boost", 1.0))
+        return MatchPhrasePrefixQuery(fname, str(v))
+
+    if kind == "geo_distance":
+        fields = {k: v for k, v in spec.items()
+                  if k not in ("distance", "boost", "validation_method",
+                               "distance_type")}
+        if len(fields) != 1:
+            raise ParsingError("[geo_distance] requires exactly one field")
+        fname, point = next(iter(fields.items()))
+        lat, lon = parse_geo_point(point)
+        return GeoDistanceQuery(fname, lat=lat, lon=lon,
+                                distance_m=parse_distance_m(spec["distance"]),
+                                boost=spec.get("boost", 1.0))
+
+    if kind == "geo_bounding_box":
+        fields = {k: v for k, v in spec.items()
+                  if k not in ("boost", "validation_method", "type")}
+        if len(fields) != 1:
+            raise ParsingError("[geo_bounding_box] requires exactly one field")
+        fname, box = next(iter(fields.items()))
+        tl = parse_geo_point(box["top_left"])
+        br = parse_geo_point(box["bottom_right"])
+        return GeoBoundingBoxQuery(fname, top=tl[0], left=tl[1],
+                                   bottom=br[0], right=br[1],
+                                   boost=box.get("boost", spec.get("boost", 1.0)))
 
     raise ParsingError(f"unknown query [{kind}]")
 

@@ -315,11 +315,9 @@ class ServingSnapshot:
         self._lock = threading.Lock()
 
     def key(self):
-        return tuple((p.shard_id, id(p.segment), p.live_epoch)
+        # MUST mirror engine.searcher_version(): (shard_id, seg_id, epoch)
+        return tuple((p.shard_id, p.segment.seg_id, p.live_epoch)
                      for p in self.partitions)
-
-    # key() must produce the same tuples ServingContext.snapshot probes
-    # via engine.searcher_version(): (shard_id, id(segment), live_epoch)
 
     # ---- per-field state ----
 

@@ -234,8 +234,15 @@ def _brute_bool(segments, stacked, spec, k):
     return out[:k]
 
 
-@pytest.mark.parametrize("n_shards", [1, 2])
-def test_search_bool_matches_brute_force(n_shards):
+@pytest.mark.parametrize("n_shards,host_conj_df", [(1, 0), (2, 0),
+                                                   (1, 1 << 16)])
+def test_search_bool_matches_brute_force(n_shards, host_conj_df, monkeypatch):
+    """host_conj_df=0 forces every query onto the DEVICE program; the
+    default threshold routes these small-df queries to the host sparse
+    conjunction — both must match the brute-force reference exactly."""
+    import elasticsearch_tpu.parallel.blockmax as bm
+
+    monkeypatch.setattr(bm, "_HOST_CONJ_DF", host_conj_df)
     rng = np.random.default_rng(41)
     segments = zipf_corpus(rng, N_DOCS, n_shards)
     mesh = make_mesh(n_shards, dp=1)

@@ -1,0 +1,75 @@
+"""Request cache + per-segment filter-mask cache (VERDICT r2 missing #9)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.state import IndexMetadata
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture()
+def svc():
+    meta = IndexMetadata(index="c", uuid="u", settings=Settings({}), mappings={
+        "properties": {"body": {"type": "text"}, "n": {"type": "integer"},
+                       "tag": {"type": "keyword"}}})
+    svc = IndexService(meta)
+    rng = np.random.default_rng(2)
+    for i in range(100):
+        svc.index_doc(str(i), {"body": f"w{rng.integers(0, 20)} filler",
+                               "n": i, "tag": f"t{i % 4}"})
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+def test_request_cache_hit_and_invalidation(svc):
+    body = {"query": {"match": {"body": "w3"}}, "size": 0,
+            "aggs": {"m": {"max": {"field": "n"}}}, "track_total_hits": True}
+    r1 = svc.search(body)
+    assert svc.request_cache_stats == {"hits": 0, "misses": 1}
+    r2 = svc.search(body)
+    assert svc.request_cache_stats["hits"] == 1
+    assert r2["aggregations"] == r1["aggregations"]
+    assert r2["hits"]["total"] == r1["hits"]["total"]
+    # a write + refresh changes the searcher version -> miss, fresh result
+    svc.index_doc("new", {"body": "w3 filler", "n": 999})
+    svc.refresh()
+    r3 = svc.search(body)
+    assert svc.request_cache_stats["misses"] == 2
+    assert r3["hits"]["total"]["value"] == r1["hits"]["total"]["value"] + 1
+    assert r3["aggregations"]["m"]["value"] == 999.0
+
+
+def test_sized_requests_not_cached(svc):
+    body = {"query": {"match": {"body": "w3"}}, "size": 5}
+    svc.search(body)
+    svc.search(body)
+    assert svc.request_cache_stats["hits"] == 0
+
+
+def test_cached_response_isolated_from_mutation(svc):
+    body = {"query": {"match_all": {}}, "size": 0, "track_total_hits": True}
+    r1 = svc.search(body)
+    r1["hits"]["total"]["value"] = -1   # caller mutates its copy
+    r2 = svc.search(body)
+    assert r2["hits"]["total"]["value"] != -1
+
+
+def test_filter_mask_cache_reused(svc):
+    searcher = svc.shards[0].acquire_searcher()
+    seg = searcher.views[0].segment
+    before = [k for k in seg._device if k.startswith("qcache:")]
+    body = {"query": {"bool": {"must": [{"match": {"body": "w3"}}],
+                               "filter": [{"range": {"n": {"gte": 10}}},
+                                          {"term": {"tag": "t1"}}]}}}
+    svc._search_dense(body)
+    after = [k for k in seg._device if k.startswith("qcache:")]
+    assert len(after) >= len(before) + 1   # range mask cached
+    svc._search_dense(body)                # reuse, no growth
+    assert [k for k in seg._device
+            if k.startswith("qcache:")] == after
+    # results correct across the cache
+    r = svc._search_dense(body)
+    for h in r["hits"]["hits"]:
+        assert int(h["_source"]["n"]) >= 10 and h["_source"]["tag"] == "t1"

@@ -325,3 +325,40 @@ def test_peer_recovery_at_scale_100k_docs():
         node = next(n for n in nodes if n.node_name == r.node_id)
         assert node.shard_service.get_shard("docs", 0).engine.doc_count() \
             == 100_000
+
+
+def test_can_match_skips_shards_without_required_terms():
+    """Coordinator pre-filter (ref CanMatchPreFilterSearchPhase): shards
+    provably holding no copy of a required term are skipped."""
+    nodes, store, channels = make_cluster()
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(4, 0))
+    # route a unique term to whichever shard doc "special" hashes to
+    a.bulk("docs", [{"op": "index", "id": "special",
+                     "source": {"n": 1, "body": "uniqueterm only here"}}]
+           + bulk_ops(0, 40))
+    a.refresh("docs")
+    r = b.search("docs", {"query": {"term": {"body": "uniqueterm"}},
+                          "track_total_hits": True})
+    assert r["hits"]["total"]["value"] == 1
+    assert r["_shards"]["skipped"] >= 1
+    assert r["_shards"]["successful"] == r["_shards"]["total"]
+    # a term present everywhere skips nothing
+    r2 = b.search("docs", {"query": {"term": {"body": "common"}},
+                           "track_total_hits": True})
+    assert r2["_shards"]["skipped"] == 0
+    assert r2["hits"]["total"]["value"] == 40
+
+
+def test_adaptive_replica_selection_updates_ewma():
+    nodes, store, channels = make_cluster()
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(2, 1))
+    a.bulk("docs", bulk_ops(0, 30))
+    a.refresh("docs")
+    # search from the master (no local copies): remote selection by EWMA
+    svc = master.search_action
+    for _ in range(3):
+        master.search("docs", {"query": {"match": {"body": "common"}}})
+    assert svc._node_ewma_ms, "EWMA stats must accumulate"
+    assert all(v >= 0 for v in svc._node_ewma_ms.values())

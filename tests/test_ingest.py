@@ -1,0 +1,156 @@
+"""Ingest pipelines (VERDICT r2 missing #6): processors, on_failure chains,
+drop, bulk integration, default_pipeline, _simulate."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.ingest import (
+    IngestDocument, IngestProcessorError, IngestService, PipelineMissingError,
+)
+
+
+@pytest.fixture()
+def svc():
+    return IngestService()
+
+
+def run(svc, processors, source, **kw):
+    svc.put_pipeline("p", {"processors": processors})
+    return svc.process("p", source, **kw)
+
+
+def test_set_remove_rename(svc):
+    out = run(svc, [
+        {"set": {"field": "env", "value": "prod"}},
+        {"set": {"field": "greeting", "value": "hi {{user.name}}"}},
+        {"rename": {"field": "old", "target_field": "new"}},
+        {"remove": {"field": "junk"}},
+    ], {"user": {"name": "kim"}, "old": 1, "junk": True})
+    assert out == {"user": {"name": "kim"}, "env": "prod",
+                   "greeting": "hi kim", "new": 1}
+
+
+def test_convert_and_string_processors(svc):
+    out = run(svc, [
+        {"convert": {"field": "n", "type": "integer"}},
+        {"convert": {"field": "flag", "type": "boolean"}},
+        {"lowercase": {"field": "tag"}},
+        {"trim": {"field": "pad"}},
+        {"split": {"field": "csv", "separator": ","}},
+        {"gsub": {"field": "phone", "pattern": r"[-\s]", "replacement": ""}},
+        {"append": {"field": "tags", "value": ["b", "c"]}},
+    ], {"n": "42", "flag": "TRUE", "tag": "HOT", "pad": "  x ",
+        "csv": "a,b", "phone": "1-800 555", "tags": "a"})
+    assert out["n"] == 42 and out["flag"] is True
+    assert out["tag"] == "hot" and out["pad"] == "x"
+    assert out["csv"] == ["a", "b"] and out["phone"] == "1800555"
+    assert out["tags"] == ["a", "b", "c"]
+
+
+def test_date_processor(svc):
+    out = run(svc, [{"date": {"field": "ts", "formats": ["UNIX"]}}],
+              {"ts": "1700000000"})
+    assert out["@timestamp"].startswith("2023-11-14T")
+    out = run(svc, [{"date": {"field": "d", "formats": ["%d/%m/%Y"],
+                              "target_field": "when"}}], {"d": "02/01/2020"})
+    assert out["when"].startswith("2020-01-02T")
+    with pytest.raises(IngestProcessorError):
+        run(svc, [{"date": {"field": "d", "formats": ["%Y"]}}],
+            {"d": "not a date"})
+
+
+def test_dissect(svc):
+    out = run(svc, [{"dissect": {
+        "field": "msg", "pattern": "%{client} - %{verb} %{path}"}}],
+        {"msg": "1.2.3.4 - GET /index.html"})
+    assert out["client"] == "1.2.3.4"
+    assert out["verb"] == "GET" and out["path"] == "/index.html"
+
+
+def test_drop_and_fail(svc):
+    assert run(svc, [{"drop": {}}], {"x": 1}) is None
+    svc.put_pipeline("f", {"processors": [
+        {"fail": {"message": "bad doc {{id}}"}}]})
+    with pytest.raises(IngestProcessorError, match="bad doc 7"):
+        svc.process("f", {"id": 7})
+
+
+def test_on_failure_chains(svc):
+    out = run(svc, [
+        {"convert": {"field": "n", "type": "integer",
+                     "on_failure": [{"set": {"field": "n", "value": -1}}]}},
+    ], {"n": "not-a-number"})
+    assert out["n"] == -1
+    # processor-level ignore_failure
+    out = run(svc, [
+        {"convert": {"field": "n", "type": "integer", "ignore_failure": True}},
+        {"set": {"field": "ok", "value": 1}},
+    ], {"n": "nope"})
+    assert out["n"] == "nope" and out["ok"] == 1
+    # pipeline-level on_failure
+    svc.put_pipeline("pf", {
+        "processors": [{"fail": {"message": "boom"}}],
+        "on_failure": [{"set": {"field": "failed", "value": True}}]})
+    assert svc.process("pf", {})["failed"] is True
+
+
+def test_unknown_processor_and_missing_pipeline(svc):
+    with pytest.raises(IngestProcessorError):
+        svc.put_pipeline("x", {"processors": [{"nope": {}}]})
+    with pytest.raises(PipelineMissingError):
+        svc.get_pipeline("ghost")
+
+
+def test_simulate(svc):
+    docs = svc.simulate(
+        {"processors": [{"uppercase": {"field": "a"}}]},
+        [{"_source": {"a": "x"}}, {"_source": {"b": 1}}])
+    assert docs[0]["doc"]["_source"]["a"] == "X"
+    assert "error" in docs[1]
+
+
+def test_bulk_and_default_pipeline_integration():
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import RestController, register_handlers
+
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+
+    def call(method, path, body=None, raw=None, params=None):
+        data = raw if raw is not None else (
+            json.dumps(body).encode() if body is not None else None)
+        resp = rc.dispatch(method, path, params or {}, data)
+        return resp.status, json.loads(resp.encode() or b"{}")
+
+    call("PUT", "/_ingest/pipeline/clean", {"processors": [
+        {"lowercase": {"field": "tag"}},
+        {"drop": {}} if False else {"set": {"field": "via", "value": "clean"}},
+    ]})
+    call("PUT", "/pipes", {"settings": {
+        "index": {"default_pipeline": "clean"}}})
+    # default pipeline applies without ?pipeline=
+    st, body = call("PUT", "/pipes/_doc/1", {"tag": "HOT"})
+    assert st in (200, 201)
+    call("POST", "/pipes/_refresh")
+    st, doc = call("GET", "/pipes/_doc/1")
+    assert doc["_source"] == {"tag": "hot", "via": "clean"}
+    # bulk with per-action pipeline + a drop pipeline
+    call("PUT", "/_ingest/pipeline/dropper", {"processors": [{"drop": {}}]})
+    lines = [
+        json.dumps({"index": {"_index": "pipes", "_id": "2",
+                              "pipeline": "dropper"}}),
+        json.dumps({"tag": "GONE"}),
+        json.dumps({"index": {"_index": "pipes", "_id": "3",
+                              "pipeline": "clean"}}),
+        json.dumps({"tag": "WARM"}),
+    ]
+    st, body = call("POST", "/_bulk", raw=("\n".join(lines) + "\n").encode())
+    assert body["items"][0]["index"]["result"] == "noop"
+    call("POST", "/pipes/_refresh")
+    st, _ = call("GET", "/pipes/_doc/2")
+    assert st == 404
+    st, doc = call("GET", "/pipes/_doc/3")
+    assert doc["_source"]["tag"] == "warm"
+    node.close()

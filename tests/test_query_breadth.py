@@ -1,0 +1,143 @@
+"""fuzzy, regexp, match_phrase_prefix, geo_point queries (VERDICT r2 #9)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.state import IndexMetadata
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.search.executor import expand_fuzzy, within_edits
+
+
+def test_within_edits():
+    assert within_edits("search", "search", 0)
+    assert within_edits("search", "saerch", 1)      # transposition = 1 edit
+    assert within_edits("search", "serch", 1)       # deletion
+    assert within_edits("search", "searchh", 1)     # insertion
+    assert within_edits("search", "sxarch", 1)      # substitution
+    assert not within_edits("search", "sxxrch", 1)
+    assert within_edits("search", "sxxrch", 2)
+    assert not within_edits("abc", "xyz", 2)
+    assert not within_edits("abcdef", "abc", 2)
+
+
+def test_expand_fuzzy_ordering_and_prefix():
+    d = ["apple", "apply", "ample", "apples", "banana", "applesauce"]
+    out = expand_fuzzy(d, "apple", 2, 0, 10)
+    assert out[0] == "apple"                         # exact first
+    assert set(out) >= {"apple", "apply", "ample", "apples"}
+    assert "banana" not in out and "applesauce" not in out
+    out = expand_fuzzy(d, "apple", 2, 2, 10)         # prefix 'ap' required
+    assert "ample" not in out
+
+
+@pytest.fixture(scope="module")
+def svc():
+    meta = IndexMetadata(index="b", uuid="u", settings=Settings({}), mappings={
+        "properties": {
+            "body": {"type": "text"},
+            "loc": {"type": "geo_point"},
+        }})
+    svc = IndexService(meta)
+    docs = [
+        {"body": "the quick brown fox", "loc": {"lat": 52.52, "lon": 13.40}},   # berlin
+        {"body": "quack brown duck", "loc": "48.85,2.35"},                       # paris
+        {"body": "quicker than lightning", "loc": [-0.12, 51.50]},               # london ([lon, lat])
+        {"body": "a slow red fox", "loc": {"lat": 40.71, "lon": -74.00}},        # nyc
+        {"body": "quantum leap", "loc": {"lat": 52.40, "lon": 13.05}},           # potsdam
+    ]
+    for i, d in enumerate(docs):
+        svc.index_doc(str(i), d)
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+def test_fuzzy_query(svc):
+    r = svc.search({"query": {"fuzzy": {"body": {"value": "quick"}}}})
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    assert "0" in ids          # quick (d=0)
+    assert "1" in ids          # quack (d=1)
+    assert "4" not in ids      # quantum (d>2)
+    r0 = svc.search({"query": {"fuzzy": {"body": {"value": "quick",
+                                                  "fuzziness": 0}}}})
+    assert {h["_id"] for h in r0["hits"]["hits"]} == {"0"}
+
+
+def test_regexp_query(svc):
+    r = svc.search({"query": {"regexp": {"body": {"value": "qu.*k"}}}})
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    assert ids == {"0", "1"}   # quick, quack (anchored full match)
+
+
+def test_match_phrase_prefix(svc):
+    r = svc.search({"query": {"match_phrase_prefix": {"body": "quick bro"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["0"]
+    r = svc.search({"query": {"match_phrase_prefix": {"body": "slow red fo"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["3"]
+    r = svc.search({"query": {"match_phrase_prefix": {"body": "brown elephant"}}})
+    assert r["hits"]["hits"] == []
+
+
+def test_geo_distance(svc):
+    # 50km around berlin: berlin + potsdam
+    r = svc.search({"query": {"geo_distance": {
+        "distance": "50km", "loc": {"lat": 52.52, "lon": 13.40}}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"0", "4"}
+    # 1200km: adds paris + london
+    r = svc.search({"query": {"geo_distance": {
+        "distance": "1200km", "loc": "52.52,13.40"}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"0", "1", "2", "4"}
+
+
+def test_geo_bounding_box(svc):
+    r = svc.search({"query": {"geo_bounding_box": {"loc": {
+        "top_left": {"lat": 55.0, "lon": -1.0},
+        "bottom_right": {"lat": 45.0, "lon": 15.0}}}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"0", "1", "2", "4"}
+    # narrow box around only berlin/potsdam
+    r = svc.search({"query": {"geo_bounding_box": {"loc": {
+        "top_left": {"lat": 53.0, "lon": 12.0},
+        "bottom_right": {"lat": 52.0, "lon": 14.0}}}}})
+    assert {h["_id"] for h in r["hits"]["hits"]} == {"0", "4"}
+
+
+def test_geo_in_bool_filter(svc):
+    r = svc.search({"query": {"bool": {
+        "must": [{"match": {"body": "fox"}}],
+        "filter": [{"geo_distance": {"distance": "100km",
+                                     "loc": {"lat": 52.5, "lon": 13.4}}}]}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["0"]
+
+
+def test_fuzzy_highlight(svc):
+    r = svc.search({"query": {"fuzzy": {"body": "quick"}},
+                    "highlight": {"fields": {"body": {}}}})
+    by_id = {h["_id"]: h for h in r["hits"]["hits"]}
+    assert "<em>quack</em>" in by_id["1"]["highlight"]["body"][0]
+
+
+def test_multivalued_geo_keeps_pairing():
+    """Review r3 finding: per-axis sorted columns scrambled lat/lon pairs.
+    The paired GeoColumn must match only the doc's ACTUAL points."""
+    meta = IndexMetadata(index="mv", uuid="u", settings=Settings({}), mappings={
+        "properties": {"loc": {"type": "geo_point"}}})
+    svc = IndexService(meta)
+    svc.index_doc("1", {"loc": [{"lat": 10.0, "lon": 50.0},
+                                {"lat": 20.0, "lon": 40.0}]})
+    svc.refresh()
+    # the scrambled cross-pair (10, 40) must NOT match
+    r = svc.search({"query": {"geo_distance": {
+        "distance": "10km", "loc": {"lat": 10.0, "lon": 40.0}}}})
+    assert r["hits"]["hits"] == []
+    # both real points match
+    for lat, lon in ((10.0, 50.0), (20.0, 40.0)):
+        r = svc.search({"query": {"geo_distance": {
+            "distance": "10km", "loc": {"lat": lat, "lon": lon}}}})
+        assert [h["_id"] for h in r["hits"]["hits"]] == ["1"], (lat, lon)
+    # bounding box around a cross-pair must not match either
+    r = svc.search({"query": {"geo_bounding_box": {"loc": {
+        "top_left": {"lat": 11.0, "lon": 39.0},
+        "bottom_right": {"lat": 9.0, "lon": 41.0}}}}})
+    assert r["hits"]["hits"] == []
+    svc.close()
