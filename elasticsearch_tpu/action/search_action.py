@@ -36,6 +36,7 @@ ACTION_QUERY = "indices:data/read/search[phase/query]"
 ACTION_FETCH = "indices:data/read/search[phase/fetch/id]"
 ACTION_FREE = "indices:data/read/search[free_context]"
 ACTION_CAN_MATCH = "indices:data/read/search[can_match]"
+_PRE_FILTER_SHARD_SIZE = 4   # ref default is 128; our meshes are smaller
 
 
 def _py(v):
@@ -136,6 +137,10 @@ class SearchActionService:
     def _required_terms(body: dict) -> List[Tuple[str, str]]:
         """(field, term) pairs every match must contain — conservative: only
         top-level term queries and bool.must/filter term queries qualify."""
+        if body.get("knn") is not None:
+            # knn hits union with query hits (query_phase mask | knn mask):
+            # a shard with no query-term match can still contribute neighbors
+            return []
         query = body.get("query") or {}
         out: List[Tuple[str, str]] = []
 
@@ -194,8 +199,11 @@ class SearchActionService:
         # ---- can_match pre-filter: skip shards that provably hold no
         # matches (ref: CanMatchPreFilterSearchPhase — only bothers when
         # there are enough shards for skipping to pay for the round) ----
+        # ref: pre_filter_shard_size — below the threshold the extra
+        # round-trip costs more than the skips save
         skipped = 0
-        required = self._required_terms(body) if len(targets) > 1 else []
+        required = self._required_terms(body) \
+            if len(targets) >= _PRE_FILTER_SHARD_SIZE else []
         if required:
             kept = []
             for node, index, sid in targets:
@@ -227,6 +235,12 @@ class SearchActionService:
                 took_ms = (time.monotonic() - t_q) * 1000.0
                 prev = self._node_ewma_ms.get(node, took_ms)
                 self._node_ewma_ms[node] = 0.7 * prev + 0.3 * took_ms
+                # age every OTHER node's stat toward zero so a once-bad
+                # node is retried eventually (ref: ResponseCollectorService
+                # adjusts stats for unselected nodes)
+                for other in self._node_ewma_ms:
+                    if other != node:
+                        self._node_ewma_ms[other] *= 0.98
             except Exception:  # noqa: BLE001
                 failed += 1
                 # penalize the node so ARS stops preferring a failing copy

@@ -119,12 +119,16 @@ class IngestService:
         return pipeline_id in self._pipelines
 
     def process(self, pipeline_id: str, source: dict, index: str = "",
-                doc_id: str = "") -> Optional[dict]:
-        """Run one source dict through a pipeline. Returns the transformed
-        source, or None if the document was dropped."""
+                doc_id: str = "") -> Optional[tuple]:
+        """Run one source dict through a pipeline. Returns (source, index,
+        doc_id) — pipelines may REROUTE via _index/_id metadata writes (the
+        date-based-routing pattern) — or None if the document was dropped."""
         doc = IngestDocument(dict(source), index=index, doc_id=doc_id)
         out = self.get_pipeline(pipeline_id).execute(doc)
-        return out.source if out is not None else None
+        if out is None:
+            return None
+        return out.source, doc.meta.get("_index") or index, \
+            doc.meta.get("_id") or doc_id
 
     def simulate(self, pipeline_body: dict, docs: List[dict]) -> List[dict]:
         """_simulate endpoint: run ad-hoc pipeline over sample docs."""

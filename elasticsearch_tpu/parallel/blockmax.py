@@ -104,6 +104,13 @@ class BlockMaxBM25:
         self.mesh = mesh
         self.S = stacked.n_shards
         self.D = stacked.max_docs
+        # HBM cap for programs that materialize [Qc, D] dense intermediates
+        # (hot matmul + boundary top-k temporaries, ~12 bytes/element): at
+        # 10M docs an uncapped Qc=512 chunk would need 20+ GB
+        cap = int(4e9 / (12.0 * max(self.D, 1)))
+        self._qc_dense_cap = 8
+        while self._qc_dense_cap * 2 <= min(cap, 512):
+            self._qc_dense_cap *= 2
         self._terms: Dict[str, _TermMeta] = {}
         self._build_hot_columns()
 
@@ -346,12 +353,13 @@ class BlockMaxBM25:
         # product's per-search latency) ----
         t0 = _time.monotonic()
         qa_b, qa_max = _GROUP_SHAPES[0][0], _GROUP_SHAPES[0][1]
+        qa_max = min(qa_max, self._qc_dense_cap)
         a_packed = []
         off = 0
         while off < len(flat):
             chunk = flat[off: off + qa_max]
             off += len(chunk)
-            # two sizes only (8 or the nominal max): every extra (shape)
+            # two sizes only (8 or the capped max): every extra (shape)
             # pair is a fresh XLA compile — keep the program cache tiny
             qa_qc = max(dp, 8 if len(chunk) <= 8 else qa_max)
             if len(chunk) < qa_qc:
@@ -408,6 +416,8 @@ class BlockMaxBM25:
         t3 = _time.monotonic()
         pending = []   # (query_indices, packed)
         for ((bucket, qc_max), has_hot), members in sorted(groups.items()):
+            if has_hot:   # dense [Qc, D] intermediates: respect the HBM cap
+                qc_max = min(qc_max, self._qc_dense_cap)
             for off in range(0, len(members), qc_max):
                 grp = members[off: off + qc_max]
                 idxs = list(grp)
@@ -603,6 +613,8 @@ class BlockMaxBM25:
         for qi_ in overflow:
             out[qi_] = self._bool_exhaustive(*specs[qi_], k)
         for (bucket, qc), members in sorted(groups.items()):
+            # _bool_program holds TWO [Qc, D] dense intermediates
+            qc = min(qc, max(self._qc_dense_cap // 2, 8))
             qc = max(qc, self.mesh.shape.get("dp", 1))
             for off in range(0, len(members), qc):
                 if check is not None:

@@ -278,11 +278,15 @@ class _Handlers:
         if req.param("if_seq_no") is not None:
             kw["if_seq_no"] = req.param_int("if_seq_no")
             kw["if_primary_term"] = req.param_int("if_primary_term")
-        source = self._run_pipeline(name, doc_id, req.body or {},
+        routed = self._run_pipeline(name, doc_id, req.body or {},
                                     req.param("pipeline"))
-        if source is None:   # dropped by the pipeline
+        if routed is None:   # dropped by the pipeline
             return _ok({"_index": name, "_id": doc_id, "result": "noop",
                         "_shards": {"total": 0, "successful": 0, "failed": 0}})
+        source, name, doc_id = routed
+        if not self.node.indices.has(name):
+            self.node.create_index(name, {})   # pipeline rerouted the doc
+        svc = self.node.indices.get(name)
         result = svc.index_doc(doc_id, source, op_type=op_type, **kw)
         if req.param("refresh") in ("true", "", "wait_for"):
             svc.refresh()
@@ -423,13 +427,18 @@ class _Handlers:
                         import uuid as _uuid
 
                         doc_id = _uuid.uuid4().hex[:20]
-                    source = self._run_pipeline(
+                    routed = self._run_pipeline(
                         index, doc_id, source,
                         meta.get("pipeline", req.param("pipeline")))
-                    if source is None:   # dropped by the pipeline
+                    if routed is None:   # dropped by the pipeline
                         items.append({op: {"_index": index, "_id": doc_id,
                                            "result": "noop", "status": 200}})
                         continue
+                    source, index, doc_id = routed
+                    if not self.node.indices.has(index):
+                        self.node.create_index(index, {})
+                    svc = self.node.indices.get(index)
+                    touched.add(index)
                     result = svc.index_doc(doc_id, source,
                                            op_type="create" if op == "create" else "index")
                     items.append({op: {**self._write_response(index, result),
@@ -546,7 +555,7 @@ class _Handlers:
             meta = self.node.indices.get(index).meta
             pid = meta.settings.raw("index.default_pipeline")
         if not pid or pid == "_none":
-            return source
+            return source, index, doc_id
         return self.node.ingest.process(pid, source, index=index,
                                         doc_id=doc_id or "")
 

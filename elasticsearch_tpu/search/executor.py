@@ -46,15 +46,16 @@ B = 0.75
 MAX_TERM_EXPANSIONS = 1024  # ref: index.max_terms_count / MultiTermQuery rewrites
 
 
-def within_edits(a: str, b: str, max_d: int) -> bool:
-    """Optimal-string-alignment distance <= max_d (the reference's fuzzy
-    semantics: Damerau-Levenshtein with adjacent transpositions; ref:
-    Lucene LevenshteinAutomata). Banded DP, early exit."""
+def edit_distance_capped(a: str, b: str, max_d: int) -> int | None:
+    """Optimal-string-alignment distance if <= max_d, else None (the
+    reference's fuzzy semantics: Damerau-Levenshtein with adjacent
+    transpositions; ref: Lucene LevenshteinAutomata). Banded DP with
+    early exit; returns the DISTANCE so callers never re-run the DP."""
     la, lb = len(a), len(b)
     if abs(la - lb) > max_d:
-        return False
+        return None
     if max_d == 0:
-        return a == b
+        return 0 if a == b else None
     prev2 = None
     prev = list(range(lb + 1))
     for i in range(1, la + 1):
@@ -69,24 +70,34 @@ def within_edits(a: str, b: str, max_d: int) -> bool:
             cur[j] = v
             row_min = min(row_min, v)
         if row_min > max_d:
-            return False
+            return None
         prev2, prev = prev, cur
-    return prev[lb] <= max_d
+    return prev[lb] if prev[lb] <= max_d else None
+
+
+def within_edits(a: str, b: str, max_d: int) -> bool:
+    return edit_distance_capped(a, b, max_d) is not None
 
 
 def expand_fuzzy(dictionary, value: str, max_edits: int, prefix_length: int,
                  max_expansions: int, check=None):
     """Dictionary terms within max_edits of value (sharing the required
-    prefix), nearest-first, capped at max_expansions."""
+    prefix), nearest-first, capped at max_expansions. The dictionary is
+    sorted, so a required prefix narrows the scan to its bisect range."""
+    import bisect
+
     prefix = value[:prefix_length]
+    lo, hi = 0, len(dictionary)
+    if prefix:
+        lo = bisect.bisect_left(dictionary, prefix)
+        hi = bisect.bisect_left(dictionary, prefix + "\uffff")
     out = []
-    for i, t in enumerate(dictionary):
-        if check is not None and i % 65536 == 0:
+    for i in range(lo, hi):
+        if check is not None and (i - lo) % 65536 == 0:
             check()
-        if prefix and not t.startswith(prefix):
-            continue
-        if within_edits(t, value, max_edits):
-            d = 0 if t == value else (1 if within_edits(t, value, 1) else 2)
+        t = dictionary[i]
+        d = edit_distance_capped(t, value, max_edits)
+        if d is not None:
             out.append((d, t))
     out.sort()
     return [t for _, t in out[:max_expansions]]

@@ -1,0 +1,268 @@
+"""YAML REST conformance runner.
+
+Re-designs the reference's compatibility harness (ref:
+test/framework/.../rest/yaml/ESClientYamlSuiteTestCase.java executing the
+330 suites under rest-api-spec/src/main/resources/rest-api-spec/test/):
+suites are YAML documents of `do` steps (an API call) and assertions
+(`match`, `length`, `is_true`, `is_false`, `gt`, `lt`, `gte`, `lte`,
+`set`). The runner executes them against THIS framework's REST controller
+— the same dispatch surface HTTP clients hit — so a green suite is an API
+compatibility statement.
+
+Supported skeleton mirrors the reference: each YAML doc section is one
+test; a `setup` section runs before each test in the file; `$stashed`
+variables from `set` substitute into later steps; `catch` asserts errors.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import yaml
+
+# api name -> (method, path template); path params in {braces} consume from
+# the step's params (ref: rest-api-spec/api/*.json definitions)
+API_TABLE: Dict[str, Tuple[str, str]] = {
+    "indices.create": ("PUT", "/{index}"),
+    "indices.delete": ("DELETE", "/{index}"),
+    "indices.get": ("GET", "/{index}"),
+    "indices.exists": ("HEAD", "/{index}"),
+    "indices.get_mapping": ("GET", "/{index}/_mapping"),
+    "indices.put_mapping": ("PUT", "/{index}/_mapping"),
+    "indices.refresh": ("POST", "/{index}/_refresh"),
+    "indices.flush": ("POST", "/{index}/_flush"),
+    "indices.forcemerge": ("POST", "/{index}/_forcemerge"),
+    "indices.stats": ("GET", "/{index}/_stats"),
+    "indices.get_alias": ("GET", "/{index}/_alias"),
+    "indices.update_aliases": ("POST", "/_aliases"),
+    "indices.analyze": ("POST", "/{index}/_analyze"),
+    "index": ("PUT", "/{index}/_doc/{id}"),
+    "create": ("PUT", "/{index}/_create/{id}"),
+    "get": ("GET", "/{index}/_doc/{id}"),
+    "exists": ("HEAD", "/{index}/_doc/{id}"),
+    "get_source": ("GET", "/{index}/_source/{id}"),
+    "delete": ("DELETE", "/{index}/_doc/{id}"),
+    "update": ("POST", "/{index}/_update/{id}"),
+    "mget": ("POST", "/_mget"),
+    "bulk": ("POST", "/_bulk"),
+    "search": ("POST", "/{index}/_search"),
+    "msearch": ("POST", "/_msearch"),
+    "count": ("POST", "/{index}/_count"),
+    "scroll": ("POST", "/_search/scroll"),
+    "clear_scroll": ("DELETE", "/_search/scroll"),
+    "open_point_in_time": ("POST", "/{index}/_pit"),
+    "close_point_in_time": ("DELETE", "/_pit"),
+    "delete_by_query": ("POST", "/{index}/_delete_by_query"),
+    "update_by_query": ("POST", "/{index}/_update_by_query"),
+    "cluster.health": ("GET", "/_cluster/health"),
+    "cluster.state": ("GET", "/_cluster/state"),
+    "cluster.stats": ("GET", "/_cluster/stats"),
+    "nodes.info": ("GET", "/_nodes"),
+    "nodes.stats": ("GET", "/_nodes/stats"),
+    "cat.indices": ("GET", "/_cat/indices"),
+    "cat.count": ("GET", "/_cat/count"),
+    "cat.health": ("GET", "/_cat/health"),
+    "cat.shards": ("GET", "/_cat/shards"),
+    "tasks.list": ("GET", "/_tasks"),
+    "ingest.put_pipeline": ("PUT", "/_ingest/pipeline/{id}"),
+    "ingest.get_pipeline": ("GET", "/_ingest/pipeline/{id}"),
+    "ingest.delete_pipeline": ("DELETE", "/_ingest/pipeline/{id}"),
+    "ingest.simulate": ("POST", "/_ingest/pipeline/_simulate"),
+    "snapshot.create_repository": ("PUT", "/_snapshot/{repository}"),
+    "snapshot.create": ("PUT", "/_snapshot/{repository}/{snapshot}"),
+    "snapshot.get": ("GET", "/_snapshot/{repository}/{snapshot}"),
+    "snapshot.delete": ("DELETE", "/_snapshot/{repository}/{snapshot}"),
+    "snapshot.restore": ("POST", "/_snapshot/{repository}/{snapshot}/_restore"),
+    "info": ("GET", "/"),
+}
+
+_NDJSON_APIS = {"bulk", "msearch"}
+
+
+class StepFailure(AssertionError):
+    pass
+
+
+class YamlTestRunner:
+    """Executes one suite file against a fresh node's RestController."""
+
+    def __init__(self, dispatch):
+        """dispatch(method, path, params, raw_body) -> (status, body_dict)"""
+        self.dispatch = dispatch
+        self.stash: Dict[str, Any] = {}
+        self.last_response: Any = None
+        self.last_status: int = 0
+
+    # ---- value plumbing ----
+
+    def _sub(self, value):
+        """$var substitution into strings/structures."""
+        if isinstance(value, str):
+            if value.startswith("$"):
+                return self.stash.get(value[1:], value)
+            return re.sub(r"\$\{(\w+)\}",
+                          lambda m: str(self.stash.get(m.group(1), "")), value)
+        if isinstance(value, dict):
+            return {k: self._sub(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [self._sub(v) for v in value]
+        return value
+
+    def lookup(self, path: str):
+        """Dotted/escaped path into the last response ('' = whole body).
+        `\\.` escapes literal dots in keys (field names)."""
+        if path in ("", "$body"):
+            return self.last_response
+        node = self.last_response
+        parts = re.split(r"(?<!\\)\.", path)
+        for raw in parts:
+            p = raw.replace("\\.", ".")
+            p = self._sub(p)
+            if isinstance(node, list):
+                node = node[int(p)]
+            elif isinstance(node, dict):
+                if p not in node:
+                    raise StepFailure(f"path [{path}]: key [{p}] missing "
+                                      f"in {json.dumps(node)[:300]}")
+                node = node[p]
+            else:
+                raise StepFailure(f"path [{path}]: cannot descend into "
+                                  f"{type(node).__name__}")
+        return node
+
+    # ---- steps ----
+
+    def run_do(self, spec: dict) -> None:
+        spec = dict(spec)
+        catch = spec.pop("catch", None)
+        headers = spec.pop("headers", None)  # accepted, unused
+        if len(spec) != 1:
+            raise StepFailure(f"do step must name one api: {list(spec)}")
+        api, params = next(iter(spec.items()))
+        params = self._sub(params or {})
+        if api not in API_TABLE:
+            raise StepFailure(f"unsupported api [{api}]")
+        method, template = API_TABLE[api]
+        body = params.pop("body", None)
+        path = template
+        for m in re.findall(r"\{(\w+)\}", template):
+            if m in params:
+                path = path.replace("{" + m, "{" + m)  # keep
+            else:
+                # optional path params collapse (e.g. /{index}/_search -> /_search)
+                pass
+        try:
+            path = template.format(**{k: params.pop(k) for k in
+                                      re.findall(r"\{(\w+)\}", template)})
+        except KeyError as e:
+            raise StepFailure(f"[{api}] missing path param {e}")
+        if api in _NDJSON_APIS:
+            lines = body if isinstance(body, list) else [body]
+            raw = ("\n".join(json.dumps(ln) for ln in lines) + "\n").encode()
+        elif body is not None:
+            raw = json.dumps(body).encode()
+        else:
+            raw = None
+        qparams = {k: str(v) for k, v in params.items()}
+        status, resp = self.dispatch(method, path, qparams, raw)
+        self.last_status = status
+        self.last_response = resp
+        if catch is not None:
+            if status < 400:
+                raise StepFailure(
+                    f"[{api}] expected error [{catch}], got {status}")
+            self._check_catch(catch, status, resp)
+        elif status >= 400:
+            raise StepFailure(f"[{api}] failed [{status}]: "
+                              f"{json.dumps(resp)[:400]}")
+
+    def _check_catch(self, catch: str, status: int, resp) -> None:
+        table = {"missing": 404, "conflict": 409, "bad_request": 400,
+                 "request": None, "param": 400, "unavailable": 503,
+                 "forbidden": 403}
+        if catch.startswith("/") and catch.endswith("/"):
+            blob = json.dumps(resp)
+            if re.search(catch[1:-1], blob) is None:
+                raise StepFailure(f"error body does not match {catch}: "
+                                  f"{blob[:300]}")
+            return
+        want = table.get(catch)
+        if want is not None and status != want:
+            raise StepFailure(f"expected [{catch}]={want}, got {status}")
+
+    def run_assert(self, kind: str, spec) -> None:
+        if kind == "match":
+            for path, want in spec.items():
+                got = self.lookup(path)
+                want = self._sub(want)
+                if isinstance(want, str) and want.startswith("/") \
+                        and want.endswith("/") and len(want) > 1:
+                    if re.search(want[1:-1].strip(), str(got), re.X) is None:
+                        raise StepFailure(
+                            f"match {path}: [{got}] !~ {want}")
+                elif got != want:
+                    raise StepFailure(f"match {path}: got "
+                                      f"{json.dumps(got)[:200]} want "
+                                      f"{json.dumps(want)[:200]}")
+        elif kind == "length":
+            for path, want in spec.items():
+                got = self.lookup(path)
+                if len(got) != int(self._sub(want)):
+                    raise StepFailure(
+                        f"length {path}: {len(got)} != {want}")
+        elif kind in ("is_true", "is_false"):
+            got = self.lookup(spec if isinstance(spec, str) else "")
+            truthy = got not in (None, False, "", 0, [], {})
+            if truthy != (kind == "is_true"):
+                raise StepFailure(f"{kind} {spec}: value was {got!r}")
+        elif kind in ("gt", "lt", "gte", "lte"):
+            import operator
+
+            ops = {"gt": operator.gt, "lt": operator.lt,
+                   "gte": operator.ge, "lte": operator.le}
+            for path, want in spec.items():
+                got = self.lookup(path)
+                if not ops[kind](float(got), float(self._sub(want))):
+                    raise StepFailure(f"{kind} {path}: {got} vs {want}")
+        elif kind == "set":
+            for path, var in spec.items():
+                self.stash[var] = self.lookup(path)
+        else:
+            raise StepFailure(f"unsupported assertion [{kind}]")
+
+    def run_steps(self, steps: List[dict]) -> None:
+        for step in steps:
+            if not isinstance(step, dict) or len(step) != 1:
+                raise StepFailure(f"malformed step {step}")
+            kind, spec = next(iter(step.items()))
+            if kind == "do":
+                self.run_do(spec)
+            elif kind == "skip":
+                continue
+            else:
+                self.run_assert(kind, spec)
+
+
+def load_suites(directory: Path) -> List[Tuple[str, str, Optional[list], list]]:
+    """[(file, test name, setup steps, test steps)] over every suite file."""
+    out = []
+    for f in sorted(directory.glob("*.yml")) + sorted(directory.glob("*.yaml")):
+        docs = list(yaml.safe_load_all(f.read_text()))
+        setup = None
+        tests = []
+        for doc in docs:
+            if not doc:
+                continue
+            for name, steps in doc.items():
+                if name == "setup":
+                    setup = steps
+                elif name == "teardown":
+                    continue
+                else:
+                    tests.append((name, steps))
+        for name, steps in tests:
+            out.append((f.name, name, setup, steps))
+    return out

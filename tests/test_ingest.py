@@ -17,7 +17,8 @@ def svc():
 
 def run(svc, processors, source, **kw):
     svc.put_pipeline("p", {"processors": processors})
-    return svc.process("p", source, **kw)
+    r = svc.process("p", source, **kw)
+    return None if r is None else r[0]
 
 
 def test_set_remove_rename(svc):
@@ -92,7 +93,7 @@ def test_on_failure_chains(svc):
     svc.put_pipeline("pf", {
         "processors": [{"fail": {"message": "boom"}}],
         "on_failure": [{"set": {"field": "failed", "value": True}}]})
-    assert svc.process("pf", {})["failed"] is True
+    assert svc.process("pf", {})[0]["failed"] is True
 
 
 def test_unknown_processor_and_missing_pipeline(svc):
@@ -100,6 +101,13 @@ def test_unknown_processor_and_missing_pipeline(svc):
         svc.put_pipeline("x", {"processors": [{"nope": {}}]})
     with pytest.raises(PipelineMissingError):
         svc.get_pipeline("ghost")
+
+
+def test_pipeline_reroutes_via_meta(svc):
+    svc.put_pipeline("route", {"processors": [
+        {"set": {"field": "_index", "value": "logs-2026"}}]})
+    out = svc.process("route", {"x": 1}, index="logs", doc_id="7")
+    assert out == ({"x": 1}, "logs-2026", "7")
 
 
 def test_simulate(svc):
@@ -126,7 +134,7 @@ def test_bulk_and_default_pipeline_integration():
 
     call("PUT", "/_ingest/pipeline/clean", {"processors": [
         {"lowercase": {"field": "tag"}},
-        {"drop": {}} if False else {"set": {"field": "via", "value": "clean"}},
+        {"set": {"field": "via", "value": "clean"}},
     ]})
     call("PUT", "/pipes", {"settings": {
         "index": {"default_pipeline": "clean"}}})

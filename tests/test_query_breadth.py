@@ -22,7 +22,8 @@ def test_within_edits():
 
 
 def test_expand_fuzzy_ordering_and_prefix():
-    d = ["apple", "apply", "ample", "apples", "banana", "applesauce"]
+    # dictionaries are segment term dicts: always sorted (bisect prefix range)
+    d = sorted(["apple", "apply", "ample", "apples", "banana", "applesauce"])
     out = expand_fuzzy(d, "apple", 2, 0, 10)
     assert out[0] == "apple"                         # exact first
     assert set(out) >= {"apple", "apply", "ample", "apples"}
