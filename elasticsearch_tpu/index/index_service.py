@@ -36,8 +36,10 @@ from elasticsearch_tpu.search.query_phase import execute_query_phase
 
 
 class IndexService:
-    def __init__(self, meta: IndexMetadata, data_path: Optional[str] = None):
+    def __init__(self, meta: IndexMetadata, data_path: Optional[str] = None,
+                 breakers=None):
         self.meta = meta
+        self.breakers = breakers
         self.name = meta.index
         analyzer_settings = meta.settings.raw("analysis")  # rarely set flat; see below
         nested = meta.settings.filtered_by_prefix("index.analysis.analyzer.")
@@ -197,8 +199,10 @@ class IndexService:
                 ex = QueryExecutor(self.mapper, global_stats)
             shard_req = request if "_after_full" not in request else \
                 {**request, "_shard_id": shard_id}
+            breaker = self.breakers.get_breaker("request") \
+                if self.breakers is not None else None
             qr = execute_query_phase(searcher, self.mapper, shard_req,
-                                     executor=ex, task=task)
+                                     executor=ex, task=task, breaker=breaker)
             shard_results.append(qr)
             for h in qr.hits:
                 per_shard_hits.append((shard_id, h))
@@ -363,10 +367,11 @@ def parse_keep_alive(value, default_s: float = 300.0) -> float:
 class IndicesService:
     """Node-level index registry (ref: indices/IndicesService.java:168)."""
 
-    def __init__(self, data_path: Optional[str] = None):
+    def __init__(self, data_path: Optional[str] = None, breakers=None):
         from elasticsearch_tpu.search.reader_context import ReaderContextRegistry
 
         self.data_path = data_path
+        self.breakers = breakers
         self._indices: Dict[str, IndexService] = {}
         self._lock = threading.Lock()
         # PIT/scroll contexts + keepalive reaper (ref: SearchService.Reaper)
@@ -424,7 +429,8 @@ class IndicesService:
                 mappings=mappings or {},
                 aliases=aliases or {},
             )
-            self._indices[name] = IndexService(meta, self.data_path)
+            self._indices[name] = IndexService(meta, self.data_path,
+                                               breakers=self.breakers)
             return meta
 
     def delete_index(self, name: str) -> None:

@@ -118,7 +118,10 @@ def phrase_freqs(fp: FieldPostings, terms: List[str], slop: int = 0,
     if len(cand) == 0:
         return np.empty(0, np.int32), np.empty(0, np.float32)
 
-    max_pos = int(fp.pos_data.max()) if len(fp.pos_data) else 0
+    max_pos = getattr(fp, "_max_pos_cache", None)
+    if max_pos is None:
+        max_pos = int(fp.pos_data.max()) if len(fp.pos_data) else 0
+        fp._max_pos_cache = max_pos   # immutable postings: compute once
     stride = max_pos + len(terms) + slop + 2
 
     # occurrences of term 0 restricted to candidate docs

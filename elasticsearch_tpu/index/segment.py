@@ -131,6 +131,18 @@ class GeoColumn:
 
 
 @dataclass
+class NestedTable:
+    """Child-table sidecar for one nested field: a full child Segment
+    (postings/columns over child rows) plus the child->parent map. The
+    TPU-first block-join: parent doc ids/seqnos/live masks are untouched;
+    nested queries score the child table and CSR-reduce to parents."""
+
+    child: "Segment"                    # child rows as their own segment
+    parent_of: np.ndarray               # [n_children] i32 parent ord (sorted)
+    child_start: np.ndarray             # [n_parents + 1] i64 CSR
+
+
+@dataclass
 class VectorColumn:
     vectors: np.ndarray                 # [n_docs, dims] f32
     norms: np.ndarray                   # [n_docs] f32
@@ -155,6 +167,7 @@ class Segment:
         seq_nos: np.ndarray,
         versions: np.ndarray | None = None,
         geo: Dict[str, "GeoColumn"] | None = None,
+        nested: Dict[str, "NestedTable"] | None = None,
     ):
         self.seg_id = seg_id
         self.n_docs = len(doc_ids)
@@ -166,6 +179,7 @@ class Segment:
         self.keyword = keyword
         self.vectors = vectors
         self.geo = geo or {}
+        self.nested = nested or {}
         self.seq_nos = seq_nos          # [n_docs] i64 — seqno of each op
         self.versions = versions if versions is not None else np.ones(self.n_docs, np.int64)
         self._device: dict = {}
@@ -180,6 +194,7 @@ class Segment:
     def __setstate__(self, state):
         self.__dict__.update(state)
         self.__dict__.setdefault("geo", {})   # pre-geo pickled segments
+        self.__dict__.setdefault("nested", {})
         self._device = {}
         self._device_lock = threading.Lock()
 
@@ -379,9 +394,12 @@ class SegmentBuilder:
         keyword_fields: dict[str, None] = {}
         vector_fields: dict[str, None] = {}
         geo_fields: dict[str, None] = {}
+        nested_fields: dict[str, None] = {}
         for d in docs:
             for f in d.geo:
                 geo_fields[f] = None
+            for f in d.nested:
+                nested_fields[f] = None
             for f in d.inverted:
                 inverted_fields[f] = None
             for f in d.numeric:
@@ -402,6 +420,7 @@ class SegmentBuilder:
         keyword = {f: self._build_keyword(f, docs) for f in keyword_fields}
         vectors = {f: self._build_vectors(f, docs) for f in vector_fields}
         geo = {f: self._build_geo(f, docs) for f in geo_fields}
+        nested = {f: self._build_nested(f, docs) for f in nested_fields}
 
         return Segment(
             seg_id=self.seg_id,
@@ -414,6 +433,7 @@ class SegmentBuilder:
             seq_nos=np.asarray(self._seq_nos, np.int64),
             versions=np.asarray(self._versions, np.int64),
             geo=geo,
+            nested=nested,
         )
 
     # ---- builders ----
@@ -508,6 +528,20 @@ class SegmentBuilder:
             doc_len=doc_len,
             sum_doc_len=float(doc_len.sum()),
         )
+
+    def _build_nested(self, fname: str, docs: List[LuceneDoc]) -> "NestedTable":
+        child_builder = SegmentBuilder(seg_id=0)
+        parent_of: List[int] = []
+        child_start = np.zeros(len(docs) + 1, np.int64)
+        for i, d in enumerate(docs):
+            child_start[i] = len(parent_of)
+            for child in d.nested.get(fname, ()):
+                child_builder.add(child, seq_no=-1)
+                parent_of.append(i)
+        child_start[len(docs)] = len(parent_of)
+        return NestedTable(child=child_builder.build(),
+                           parent_of=np.asarray(parent_of, np.int32),
+                           child_start=child_start)
 
     def _build_geo(self, fname: str, docs: List[LuceneDoc]) -> "GeoColumn":
         n = len(docs)

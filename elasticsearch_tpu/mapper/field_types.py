@@ -218,6 +218,18 @@ class DenseVectorFieldType(FieldType):
         return arr
 
 
+class NestedFieldType(FieldType):
+    """Nested object arrays (ref: index/mapper/NestedObjectMapper and
+    Lucene's block join). TPU-first re-design: instead of interleaving
+    hidden child documents into the parent doc-id space (Lucene's layout),
+    each nested field owns a columnar CHILD TABLE sidecar in the segment —
+    its own postings/columns over child rows plus a child->parent map — so
+    the nested query is a child-table scoring pass + one CSR reduce back to
+    parents, with parent doc ids, seqnos and live masks untouched."""
+
+    family = "nested"
+
+
 class GeoPointFieldType(FieldType):
     """lat/lon pairs as TWO dense numeric columns ({field}.lat/{field}.lon —
     ref: GeoPointFieldMapper; the reference packs into a BKD tree, here
@@ -279,6 +291,7 @@ _TYPES = {
     "ip": IpFieldType,
     "dense_vector": DenseVectorFieldType,
     "geo_point": GeoPointFieldType,
+    "nested": NestedFieldType,
 }
 
 

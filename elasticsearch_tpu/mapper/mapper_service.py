@@ -48,6 +48,9 @@ class LuceneDoc:
     field_lengths: Dict[str, int] = field(default_factory=dict)
     # field -> [(lat, lon)] pairs (geo_point columns keep pairing intact)
     geo: Dict[str, List[Tuple[float, float]]] = field(default_factory=dict)
+    # nested field -> list of CHILD docs (each a LuceneDoc over the child
+    # object, fields under their full dotted names)
+    nested: Dict[str, List["LuceneDoc"]] = field(default_factory=dict)
     # next free position per text field (internal; positions-gap bookkeeping)
     _pos_ceiling: Dict[str, int] = field(default_factory=dict)
 
@@ -89,6 +92,13 @@ class MapperService:
                 self._merge_props(f"{full}.", definition["properties"])
                 continue
             if definition.get("type") == "object":
+                self._merge_props(f"{full}.", definition.get("properties", {}))
+                continue
+            if definition.get("type") == "nested":
+                self._field_types[full] = build_field_type(full, definition)
+                # child sub-fields register under their dotted names; the
+                # nested root intercepts parsing so they only index into
+                # the child table, never the parent
                 self._merge_props(f"{full}.", definition.get("properties", {}))
                 continue
             new_type = build_field_type(full, definition)
@@ -143,6 +153,19 @@ class MapperService:
         for key, value in obj.items():
             full = f"{prefix}{key}"
             known = self._field_types.get(full)
+            if known is not None and known.family == "nested":
+                objs = value if isinstance(value, list) else [value]
+                children = doc.nested.setdefault(full, [])
+                for child_obj in objs:
+                    if not isinstance(child_obj, dict):
+                        raise MapperParsingError(
+                            f"object mapping for [{full}] tried to parse "
+                            "a non-object value as nested")
+                    child = LuceneDoc(doc_id=f"{doc.doc_id}#{full}#{len(children)}",
+                                      source=child_obj)
+                    self._parse_obj(f"{full}.", child_obj, child, dyn)
+                    children.append(child)
+                continue
             if isinstance(value, dict) and not (
                     known is not None and known.family == "geo"):
                 self._parse_obj(f"{full}.", value, doc, dyn)

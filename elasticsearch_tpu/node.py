@@ -34,9 +34,9 @@ class Node:
             master_node_id=self.node_id,
             nodes={self.node_id: node},
         )
-        self.indices = IndicesService(data_path)
-        self.transport = TransportService(self.node_id)
         self.breakers = HierarchyCircuitBreakerService()
+        self.indices = IndicesService(data_path, breakers=self.breakers)
+        self.transport = TransportService(self.node_id)
         from elasticsearch_tpu.tasks import TaskManager
 
         self.tasks = TaskManager(self.node_id)

@@ -42,7 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from elasticsearch_tpu.ops import bm25_idf, next_bucket
 from elasticsearch_tpu.parallel.spmd import (
     B, K1, StackedBM25, _dense_topk_tiebreak, _gather_parts, _merge_gathered,
-    _segmented_run_sums,
+    _pack_ids, _segmented_run_sums, pack_id_np, unpack_ids_np,
 )
 
 HOT_DF_FRACTION = 8     # df > total_docs/8 -> dense column
@@ -52,8 +52,7 @@ _HOST_CONJ_DF = 1 << 16  # rarest required term below this -> host conjunction
 # (block-bucket B, queries per dispatch Qc): lane work per dispatch stays
 # ~bounded (B*128*Qc lanes) so a handful of heavy queries can't inflate the
 # padding of thousands of light ones. Compile cache: one program per pair.
-_GROUP_SHAPES = [(8, 512), (32, 512), (128, 256), (512, 64),
-                 (2048, 16), (8192, 8), (32768, 4)]
+_GROUP_SHAPES = [(32, 512), (512, 64), (8192, 8), (32768, 4)]
 _MAX_BUCKET = _GROUP_SHAPES[-1][0]
 _OVERFLOW_CHUNK = 8192   # blocks per scatter-add dispatch on the overflow path
 
@@ -352,7 +351,7 @@ class BlockMaxBM25:
         # not pay a 512-query dispatch's padding — its latency is the
         # product's per-search latency) ----
         t0 = _time.monotonic()
-        qa_b, qa_max = _GROUP_SHAPES[0][0], _GROUP_SHAPES[0][1]
+        qa_b, qa_max = PASS_A_BLOCKS, _GROUP_SHAPES[0][1]
         qa_max = min(qa_max, self._qc_dense_cap)
         a_packed = []
         off = 0
@@ -471,8 +470,8 @@ class BlockMaxBM25:
         results = []
         for bi, start, n in spans:
             packed = out_all[start: start + n]
-            results.append((packed[:, 0], packed[:, 1].view(np.int32),
-                            packed[:, 2].view(np.int32)))
+            results.append((packed[:, 0], unpack_ids_np(packed[:, 1]),
+                            unpack_ids_np(packed[:, 2])))
         return results
 
     def _exhaustive_topk(self, terms: List[Tuple[str, float]],
@@ -592,7 +591,12 @@ class BlockMaxBM25:
             if nm > n_req_present:
                 # a required term is missing globally: provably empty
                 continue
-            if nm > 0 and (min_req_df or 0) <= _HOST_CONJ_DF:
+            host_cut = max(_HOST_CONJ_DF, self.stacked.total_docs // 4)
+            if nm > 0 and (min_req_df or 0) <= host_cut:
+                # conjunction output is bounded by the rarest required term:
+                # the sparse host merge beats shipping every block up to
+                # stopword-grade selectivity (measured: device only wins
+                # when ALL required terms are dense-column material)
                 host_path.append(qi_)
 
         for qi_ in host_path:
@@ -658,7 +662,7 @@ class BlockMaxBM25:
                     jnp.asarray(qi), jnp.asarray(qf), jnp.asarray(nm_arr),
                     mesh=self.mesh, k=k)
                 out[grp] = np.asarray(packed)[: len(grp)]
-        return out[:, 0], out[:, 1].view(np.int32), out[:, 2].view(np.int32)
+        return out[:, 0], unpack_ids_np(out[:, 1]), unpack_ids_np(out[:, 2])
 
     def _host_bs(self, s: int) -> np.ndarray:
         cache = getattr(self, "_host_bs_cache", None)
@@ -722,8 +726,8 @@ class BlockMaxBM25:
         packed = np.zeros((3, k), np.float32)
         for j, (sc, s, d) in enumerate(cand_out[:k]):
             packed[0, j] = sc
-            packed[1, j] = np.int32(s).view(np.float32)
-            packed[2, j] = np.int32(d).view(np.float32)
+            packed[1, j] = pack_id_np(s)
+            packed[2, j] = pack_id_np(d)
         return packed
 
     def _bool_exhaustive(self, rows, nm: int, k: int) -> np.ndarray:
@@ -768,8 +772,8 @@ class BlockMaxBM25:
         packed = np.zeros((3, k), np.float32)
         for j, (sc, s, d) in enumerate(cand[:k]):
             packed[0, j] = sc
-            packed[1, j] = np.int32(s).view(np.float32)
-            packed[2, j] = np.int32(d).view(np.float32)
+            packed[1, j] = pack_id_np(s)
+            packed[2, j] = pack_id_np(d)
         return packed
 
     def search_phrase(self, phrases: Sequence[List[str]], k: int = 10,
@@ -957,9 +961,7 @@ def _acc_topk(acc, hot_cols, live, W, *, mesh, k):
         top_s, shard_of, ord_of = _merge_gathered(
             _gather_parts(s), _gather_parts(o), k)
         return jnp.stack(
-            [top_s,
-             jax.lax.bitcast_convert_type(shard_of, jnp.float32),
-             jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
+            [top_s, _pack_ids(shard_of), _pack_ids(ord_of)], axis=1)
 
     return program(acc, hot_cols, live, W)
 
@@ -1048,9 +1050,7 @@ def _bool_program(block_docs, block_scores, live, hot_cols, W, Wp, qb, qi, qf,
         top_s, shard_of, ord_of = _merge_gathered(
             _gather_parts(s_scores), _gather_parts(s_ords), k)
         return jnp.stack(
-            [top_s,
-             jax.lax.bitcast_convert_type(shard_of, jnp.float32),
-             jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
+            [top_s, _pack_ids(shard_of), _pack_ids(ord_of)], axis=1)
 
     return program(block_docs, block_scores, live, hot_cols, W, Wp, qb, qi, qf, nm)
 
@@ -1100,9 +1100,7 @@ def _hybrid_program(block_docs, block_scores, live, hot_cols, W, qblocks, qidf,
         top_s, shard_of, ord_of = _merge_gathered(
             _gather_parts(s_scores), _gather_parts(s_ords), k)
         return jnp.stack(
-            [top_s,
-             jax.lax.bitcast_convert_type(shard_of, jnp.float32),
-             jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
+            [top_s, _pack_ids(shard_of), _pack_ids(ord_of)], axis=1)
 
     return program(block_docs, block_scores, live, hot_cols, W, qblocks, qidf)
 
@@ -1136,8 +1134,6 @@ def _lane_program(block_docs, block_scores, live, qblocks, qidf, *, mesh, k):
         top_s, shard_of, ord_of = _merge_gathered(
             _gather_parts(s_scores), _gather_parts(s_ords), k)
         return jnp.stack(
-            [top_s,
-             jax.lax.bitcast_convert_type(shard_of, jnp.float32),
-             jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
+            [top_s, _pack_ids(shard_of), _pack_ids(ord_of)], axis=1)
 
     return program(block_docs, block_scores, live, qblocks, qidf)

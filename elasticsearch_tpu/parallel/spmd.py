@@ -141,6 +141,10 @@ def build_stacked_bm25(
     S = len(segments)
     T = max(fp.block_docs.shape[0] for fp in fps)
     D = max(max(seg.n_docs, 1) for seg in segments)
+    if D >= (1 << 24):
+        raise ValueError(
+            f"partition has {D} docs; the packed-id transport carries 24-bit "
+            "ordinals — split corpora beyond 16.7M docs into more shards")
     block_docs = _pad_stack([fp.block_docs for fp in fps], (T, BLOCK), np.int32)
     block_tfs = _pad_stack([fp.block_tfs for fp in fps], (T, BLOCK), np.float32)
     doc_len = _pad_stack([fp.doc_len for fp in fps], (D,), np.float32)
@@ -343,6 +347,32 @@ def _local_bm25_topk(block_docs, block_tfs, doc_len, live, qblocks, qidf, avgdl,
         return -neg_s[:k], d_s[:k]
 
     return jax.vmap(one_query)(qblocks, qidf)
+
+
+_ID_BIAS = 0x40000000          # sets the f32 exponent field: see _pack_ids
+_ID_MASK = 0x00FFFFFF          # low 24 bits carry the id (so D < 2**24)
+
+
+def _pack_ids(x):
+    """i32 ids -> f32 lanes for packed single-transfer results.
+
+    A plain bitcast of an id < 2**23 is a SUBNORMAL f32 bit pattern, and the
+    TPU flushes subnormals to zero somewhere along the copy/fusion path —
+    ids silently became 0 at 10M-doc scale while ids >= 2**23 survived
+    (nonzero exponent). OR-ing in a high exponent bit keeps every pattern
+    normal; the id lives in the low 24 bits and unpacks with a mask."""
+    import jax
+
+    return jax.lax.bitcast_convert_type(
+        jnp.bitwise_or(x.astype(jnp.int32), jnp.int32(_ID_BIAS)), jnp.float32)
+
+
+def unpack_ids_np(f32_lanes: np.ndarray) -> np.ndarray:
+    return f32_lanes.view(np.int32) & _ID_MASK
+
+
+def pack_id_np(x: int) -> np.float32:
+    return np.int32(x | _ID_BIAS).view(np.float32)
 
 
 def _dense_topk_tiebreak(sc, k):
@@ -601,12 +631,10 @@ def _column_score_program(cache, live, qpacked, mesh, k):
         s_scores, s_ords = jax.vmap(one_part)(cache, live)
         top_s, shard_of, ord_of = _merge_gathered(
             _gather_parts(s_scores), _gather_parts(s_ords), k)
-        # bitcast i32 indices into f32 lanes (not a value cast: ordinals above
-        # 2^24 would round under astype); host side views them back as i32
+        # ids ride as biased bit patterns (see _pack_ids: a raw bitcast is
+        # subnormal for ids < 2^23 and the TPU flushes those to zero)
         return jnp.stack(
-            [top_s,
-             jax.lax.bitcast_convert_type(shard_of, jnp.float32),
-             jax.lax.bitcast_convert_type(ord_of, jnp.float32)], axis=1)
+            [top_s, _pack_ids(shard_of), _pack_ids(ord_of)], axis=1)
 
     return program(cache, live, qpacked)
 
@@ -730,4 +758,4 @@ class Bm25ColumnCache:
         out, Q = self.search_async(queries, k)
         packed = np.asarray(out)[:Q]
         return (packed[:, 0],
-                packed[:, 1].view(np.int32), packed[:, 2].view(np.int32))
+                unpack_ids_np(packed[:, 1]), unpack_ids_np(packed[:, 2]))

@@ -607,6 +607,74 @@ class QueryExecutor:
             leaf, query, lambda: self._impl_GeoBoundingBoxQuery(query, leaf)[1])
         return mask.astype(jnp.float32), mask
 
+    def _exec_NestedQuery(self, query, leaf):
+        """Block-join as a child-table pass (ref: NestedQueryBuilder ->
+        Lucene ToParentBlockJoinQuery): run the inner query over the nested
+        field's child table, then CSR-reduce matching child scores to the
+        parent per score_mode. Parent live masking happens in the normal
+        query phase; children live/die with their parent."""
+        nt = leaf.segment.nested.get(query.path)
+        if nt is None or nt.child.n_docs == 0:
+            return self._none(leaf)
+        child_scores, child_mask = self._nested_child_exec(
+            leaf, query.path, query.query)
+        cs = np.asarray(child_scores)
+        cm = np.asarray(child_mask)
+        n_parents = leaf.n_docs
+        starts = nt.child_start
+        hit = cm.astype(np.int64)
+        cum = np.concatenate([[0], np.cumsum(hit)])
+        counts = (cum[starts[1:]] - cum[starts[:-1]]).astype(np.float64)
+        mask_np = counts > 0
+        sc = np.where(cm, cs.astype(np.float64), 0.0)
+        cum_s = np.concatenate([[0.0], np.cumsum(sc)])
+        sums = cum_s[starts[1:]] - cum_s[starts[:-1]]
+        mode = query.score_mode
+        if mode == "none":
+            # ref: NestedQueryBuilder score_mode none -> constant 0 score
+            scores_np = np.zeros(n_parents, np.float64)
+        elif mode == "sum":
+            scores_np = sums
+        elif mode in ("max", "min"):
+            sentinel = -np.inf if mode == "max" else np.inf
+            vals = np.where(cm, cs.astype(np.float64), sentinel)
+            # sentinel APPENDED so trailing childless parents' starts index
+            # it instead of clamping into (and truncating) the previous
+            # parent's reduceat run; empty middle runs yield a neighboring
+            # element but are zeroed by the parent mask below
+            vals = np.append(vals, sentinel)
+            red = (np.maximum if mode == "max" else np.minimum
+                   ).reduceat(vals, starts[:-1].astype(np.int64))
+            scores_np = np.where(mask_np, red, 0.0)
+        else:  # avg (default)
+            scores_np = np.divide(sums, counts, out=np.zeros_like(sums),
+                                  where=counts > 0)
+        scores_np = np.where(mask_np, scores_np, 0.0)
+        mask = jnp.asarray(mask_np)
+        return jnp.asarray(scores_np.astype(np.float32)), mask
+
+    def _nested_child_exec(self, leaf, path, inner_query):
+        """(scores, mask) over the child table of `path` on this leaf.
+
+        The leaf/stats pair is cached per segment (immutable); the executor
+        is PER CALL — it carries this request's cancellation hook, and a
+        shared one would race across concurrent requests."""
+        from elasticsearch_tpu.index.engine import SegmentView
+
+        nt = leaf.segment.nested[path]
+        cache_key = f"nestedleaf:{path}"
+        ctx = leaf.segment._device.get(cache_key)
+        if ctx is None:
+            view = SegmentView(segment=nt.child,
+                               live=np.ones(nt.child.n_docs, bool),
+                               live_epoch=0)
+            ctx = (LeafContext(view, base=0), ShardStats([view]))
+            leaf.segment._device[cache_key] = ctx
+        child_leaf, child_stats = ctx
+        child_ex = QueryExecutor(self.mapper, child_stats)
+        child_ex.check = self.check
+        return child_ex.execute(inner_query, child_leaf)
+
     # ---- helpers ----
 
     _QUERY_CACHE_MAX = 32   # cached filter masks per segment (FIFO)

@@ -82,10 +82,18 @@ def execute_fetch_phase(
     fields_spec = request.get("fields")
     highlight_spec = request.get("highlight")
     hl_query = None
-    if highlight_spec and mapper is not None and request.get("query"):
+    parsed_query = None
+    if mapper is not None and request.get("query"):
         from elasticsearch_tpu.search.queries import parse_query
 
-        hl_query = parse_query(request["query"])
+        try:
+            parsed_query = parse_query(request["query"])
+        except Exception:  # noqa: BLE001 — fetch must not fail on parse
+            parsed_query = None
+    if highlight_spec and parsed_query is not None:
+        hl_query = parsed_query
+    inner_specs = _collect_inner_hits(parsed_query) if parsed_query else []
+    _ih_cache: dict = {}   # (leaf_idx, spec idx) -> child (scores, mask)
     out = []
     for h in hits:
         seg = searcher.views[h.leaf_idx].segment
@@ -107,7 +115,79 @@ def execute_fetch_phase(
             hl = highlight_hit(seg, h.ord, highlight_spec, hl_query, mapper)
             if hl:
                 hit["highlight"] = hl
+        if inner_specs:
+            ih = _render_inner_hits(searcher, h, inner_specs, mapper,
+                                    index_name, _ih_cache)
+            if ih:
+                hit["inner_hits"] = ih
         out.append(hit)
+    return out
+
+
+def _collect_inner_hits(query) -> list:
+    """(name, NestedQuery) pairs for every nested query with inner_hits."""
+    from elasticsearch_tpu.search import queries as q
+
+    out = []
+
+    def walk(node):
+        if node is None:
+            return
+        if isinstance(node, q.NestedQuery):
+            if node.inner_hits is not None:
+                out.append((node.inner_hits.get("name", node.path), node))
+            walk(node.query)
+        elif isinstance(node, q.BoolQuery):
+            for c in list(node.must) + list(node.filter) + list(node.should):
+                walk(c)
+        elif isinstance(node, q.ConstantScoreQuery):
+            walk(node.filter)
+        elif isinstance(node, q.FunctionScoreQuery):
+            walk(node.query)
+
+    walk(query)
+    return out
+
+
+def _render_inner_hits(searcher, h: ShardHit, inner_specs, mapper,
+                       index_name: str, cache: dict) -> dict:
+    """Matching children of one parent hit (ref: fetch/subphase/InnerHits-
+    Phase.java): the child table is scored ONCE per (leaf, spec) for the
+    whole fetch — each hit then slices its parent's CSR run."""
+    import numpy as np
+
+    from elasticsearch_tpu.search.executor import (
+        LeafContext, QueryExecutor, ShardStats, leaves,
+    )
+
+    leaf = leaves(searcher)[h.leaf_idx]
+    out = {}
+    for si, (name, nq) in enumerate(inner_specs):
+        nt = leaf.segment.nested.get(nq.path)
+        if nt is None:
+            continue
+        ckey = (h.leaf_idx, si)
+        if ckey not in cache:
+            ex = QueryExecutor(mapper, ShardStats(searcher.views))
+            ccs, ccm = ex._nested_child_exec(leaf, nq.path, nq.query)
+            cache[ckey] = (np.asarray(ccs), np.asarray(ccm))
+        cs, cm = cache[ckey]
+        lo, hi = int(nt.child_start[h.ord]), int(nt.child_start[h.ord + 1])
+        idx = [i for i in range(lo, hi) if cm[i]]
+        idx.sort(key=lambda i: (-cs[i], i))
+        size = int((nq.inner_hits or {}).get("size", 3))
+        shown = idx[:size]
+        out[name] = {"hits": {
+            "total": {"value": len(idx), "relation": "eq"},
+            "max_score": float(cs[idx[0]]) if idx else None,
+            "hits": [{
+                "_index": index_name,
+                "_id": leaf.segment.doc_ids[h.ord],
+                "_nested": {"field": nq.path, "offset": i - lo},
+                "_score": float(cs[i]),
+                "_source": nt.child.sources[i],
+            } for i in shown],
+        }}
     return out
 
 

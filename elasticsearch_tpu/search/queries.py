@@ -203,6 +203,18 @@ def parse_distance_m(value) -> float:
 
 
 @dataclass
+class NestedQuery(Query):
+    """ref: index/query/NestedQueryBuilder.java — score_mode avg (default),
+    sum, max, min, none."""
+
+    path: str
+    query: Query = None
+    score_mode: str = "avg"
+    inner_hits: Optional[dict] = None
+    boost: float = 1.0
+
+
+@dataclass
 class KnnQuery(Query):
     """Top-level knn search section (ES 8 _search "knn" or query vector)."""
 
@@ -353,6 +365,12 @@ def parse_query(body: dict) -> Query:
                         num_candidates=int(spec.get("num_candidates", 100)),
                         filter=parse_query(spec["filter"]) if spec.get("filter") else None,
                         boost=spec.get("boost", 1.0))
+
+    if kind == "nested":
+        return NestedQuery(path=spec["path"], query=parse_query(spec["query"]),
+                           score_mode=spec.get("score_mode", "avg"),
+                           inner_hits=spec.get("inner_hits"),
+                           boost=spec.get("boost", 1.0))
 
     if kind == "fuzzy":
         fname, v = _one_entry(spec, "fuzzy")

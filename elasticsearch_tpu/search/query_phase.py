@@ -69,6 +69,7 @@ def execute_query_phase(
     *,
     executor: QueryExecutor | None = None,
     task=None,
+    breaker=None,
 ) -> QuerySearchResult:
     from elasticsearch_tpu.tasks.task_manager import Deadline, parse_timeout_ms
 
@@ -249,7 +250,7 @@ def execute_query_phase(
             partials.append(collect_leaf(
                 aggs, AggContext(leaf=leaf, mapper=mapper, executor=ex,
                                  live=np.asarray(leaf.live_dev()),
-                                 scores=sc), m))
+                                 scores=sc, breaker=breaker), m))
         # reduce leaves within the shard; the coordinator reduces shards and
         # finalizes (ref P6: partials stay commutative until the final reduce)
         agg_partials = reduce_partials(aggs, partials)

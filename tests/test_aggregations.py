@@ -360,3 +360,64 @@ def test_top_hits_respects_scores_and_sort(engine):
     assert top[0]["_score"] >= top[1]["_score"] > 0
     cheapest = r["aggregations"]["cheapest"]["hits"]["hits"]
     assert cheapest[0]["_source"]["price"] == 10.0
+
+
+def test_terms_device_counts_match_host_path(monkeypatch):
+    """SURVEY §7 step 7: the device terms-count kernel must agree with the
+    per-term host loop BIT-FOR-BIT (integer doc counts)."""
+    import numpy as np
+
+    import elasticsearch_tpu.search.aggregations as agg_mod
+    from elasticsearch_tpu.cluster.state import IndexMetadata
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.index_service import IndexService
+
+    meta = IndexMetadata(index="ta", uuid="u", settings=Settings({}), mappings={
+        "properties": {"tag": {"type": "keyword"}, "body": {"type": "text"}}})
+    svc = IndexService(meta)
+    rng = np.random.default_rng(9)
+    n = 2000
+    for i in range(n):
+        tags = [f"t{rng.integers(0, 50)}"]
+        if i % 3 == 0:
+            tags.append(f"t{rng.integers(0, 50)}")   # multi-valued docs
+        svc.index_doc(str(i), {"tag": tags, "body": "w" + str(i % 7)})
+    svc.refresh()
+    body = {"query": {"match": {"body": "w3"}}, "size": 0,
+            "aggs": {"tags": {"terms": {"field": "tag", "size": 60}}}}
+
+    monkeypatch.setattr(agg_mod, "AGG_DEVICE_MIN_DOCS", 1)      # force device
+    dev = svc._search_dense(body)["aggregations"]["tags"]
+    monkeypatch.setattr(agg_mod, "AGG_DEVICE_MIN_DOCS", 1 << 60)  # force host
+    host = svc._search_dense(body)["aggregations"]["tags"]
+    assert dev == host
+    assert sum(b["doc_count"] for b in dev["buckets"]) > 0
+    svc.close()
+
+
+def test_histogram_fast_path_matches_subagg_path():
+    """The no-subagg vectorized histogram must agree with the per-bucket
+    path (forced by adding a trivial sub-agg)."""
+    import numpy as np
+
+    from elasticsearch_tpu.cluster.state import IndexMetadata
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.index_service import IndexService
+
+    meta = IndexMetadata(index="hf", uuid="u", settings=Settings({}), mappings={
+        "properties": {"n": {"type": "integer"}}})
+    svc = IndexService(meta)
+    rng = np.random.default_rng(4)
+    for i in range(500):
+        svc.index_doc(str(i), {"n": int(rng.integers(0, 100))})
+    svc.refresh()
+    fast = svc._search_dense({"size": 0, "aggs": {
+        "h": {"histogram": {"field": "n", "interval": 10}}}})
+    slow = svc._search_dense({"size": 0, "aggs": {
+        "h": {"histogram": {"field": "n", "interval": 10},
+              "aggs": {"c": {"value_count": {"field": "n"}}}}}})
+    fast_b = fast["aggregations"]["h"]["buckets"]
+    slow_b = [{k: v for k, v in b.items() if k != "c"}
+              for b in slow["aggregations"]["h"]["buckets"]]
+    assert fast_b == slow_b
+    svc.close()

@@ -219,3 +219,23 @@ def test_column_cache_never_evicts_current_batch_terms():
     import pytest as _pytest
     with _pytest.raises(ValueError):
         cache.search([["w0", "w1", "w2"], ["w4", "w5"]], k=3)
+
+
+def test_packed_id_roundtrip_covers_subnormal_range():
+    """r3 regression: ids < 2^23 bitcast to SUBNORMAL f32 patterns and the
+    TPU flushed them to zero in flight (10M-doc corpus, ords silently became
+    0). The biased packing must round-trip every id up to 2^24."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from elasticsearch_tpu.parallel.spmd import (
+        _pack_ids, pack_id_np, unpack_ids_np,
+    )
+
+    ids = np.asarray([0, 1, 127, 2**20, 2**23 - 1, 2**23, 2**24 - 1], np.int32)
+    packed = np.asarray(_pack_ids(jnp.asarray(ids)))
+    assert not np.any(np.abs(packed) < np.finfo(np.float32).tiny), \
+        "packed patterns must be NORMAL floats (no subnormals to flush)"
+    np.testing.assert_array_equal(unpack_ids_np(packed), ids)
+    for i in ids:
+        assert unpack_ids_np(np.asarray([pack_id_np(int(i))])).item() == i
