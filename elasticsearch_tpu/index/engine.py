@@ -565,30 +565,27 @@ class InternalEngine:
                 self._seqno.mark_processed(seq)
 
     def force_merge(self, max_num_segments: int = 1) -> None:
-        """Compact segments by rebuilding live docs (host recompaction)."""
+        """Compact segments by RECOMBINING columnar data (ref: Lucene
+        SegmentMerger — postings/doc values concatenate with ord remaps;
+        no _source re-parse, no re-analysis, so merging is O(postings)
+        array work instead of O(corpus re-analysis))."""
+        from elasticsearch_tpu.index.segment import merge_segments
+
         with self._lock:
             self.refresh()
             if len(self._segments) <= max_num_segments:
                 return
-            builder = SegmentBuilder(seg_id=self._next_seg_id)
-            ords: Dict[str, int] = {}
-            for seg_idx, seg in enumerate(self._segments):
-                live = self._live[seg_idx]
-                for ord_ in range(seg.n_docs):
-                    if live[ord_]:
-                        doc_id = seg.doc_ids[ord_]
-                        doc = self.mapper.parse(doc_id, seg.sources[ord_])
-                        ords[doc_id] = builder.add(doc, seq_no=int(seg.seq_nos[ord_]),
-                                                   version=int(seg.versions[ord_]))
-            merged = builder.build()
+            merged = merge_segments(self._segments, self._live,
+                                    seg_id=self._next_seg_id)
             self._segments = [merged]
             self._live = [np.ones(merged.n_docs, bool)]
             self._live_epochs = [0]
             self._next_seg_id += 1
-            for doc_id, ord_ in ords.items():
-                entry = self._versions[doc_id]
-                entry.seg_idx = 0
-                entry.ord = ord_
+            for ord_, doc_id in enumerate(merged.doc_ids):
+                entry = self._versions.get(doc_id)
+                if entry is not None and not entry.in_buffer:
+                    entry.seg_idx = 0
+                    entry.ord = ord_
 
     # ---------------- stats ----------------
 

@@ -1,0 +1,212 @@
+"""Columnar segment merge (VERDICT r2 weak #9): merge_segments must agree
+with rebuilding every live doc through the mapper, across every column
+family, with deletes."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.state import IndexMetadata
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+MAPPINGS = {"properties": {
+    "body": {"type": "text"},
+    "tag": {"type": "keyword"},
+    "n": {"type": "integer"},
+    "loc": {"type": "geo_point"},
+    "emb": {"type": "dense_vector", "dims": 4},
+    "comments": {"type": "nested", "properties": {
+        "who": {"type": "keyword"}, "text": {"type": "text"}}},
+}}
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon"]
+
+
+def make_service(seed=7, n_docs=240, refresh_every=60):
+    meta = IndexMetadata(index="m", uuid="u", settings=Settings({}),
+                         mappings=MAPPINGS)
+    svc = IndexService(meta)
+    rng = np.random.default_rng(seed)
+    for i in range(n_docs):
+        doc = {
+            "body": " ".join(rng.choice(WORDS,
+                                        size=int(rng.integers(2, 9)))),
+            "tag": [f"g{rng.integers(0, 6)}"
+                    for _ in range(int(rng.integers(1, 3)))],
+            "n": [int(rng.integers(0, 50))
+                  for _ in range(int(rng.integers(1, 3)))],
+        }
+        if i % 3 == 0:
+            doc["loc"] = {"lat": float(rng.uniform(-80, 80)),
+                          "lon": float(rng.uniform(-170, 170))}
+        if i % 4 == 0:
+            doc["emb"] = [float(x) for x in rng.standard_normal(4)]
+        if i % 5 == 0:
+            doc["comments"] = [
+                {"who": f"u{rng.integers(0, 4)}",
+                 "text": " ".join(rng.choice(WORDS, size=3))}
+                for _ in range(int(rng.integers(1, 3)))]
+        svc.index_doc(str(i), doc)
+        if i % refresh_every == refresh_every - 1:
+            svc.refresh()
+    for i in range(0, n_docs, 7):
+        svc.delete_doc(str(i))
+    svc.refresh()
+    return svc
+
+
+QUERIES = [
+    {"query": {"match": {"body": "alpha beta"}}, "size": 30,
+     "track_total_hits": True},
+    {"query": {"bool": {"must": [{"term": {"body": "gamma"}}],
+                        "filter": [{"term": {"tag": "g2"}}]}}, "size": 30},
+    {"query": {"range": {"n": {"gte": 20, "lte": 40}}}, "size": 30,
+     "sort": [{"n": "asc"}], "track_total_hits": True},
+    {"query": {"match_phrase": {"body": "alpha beta"}}, "size": 30},
+    {"query": {"geo_distance": {"distance": "3000km",
+                                "loc": {"lat": 10, "lon": 10}}}, "size": 30},
+    {"query": {"nested": {"path": "comments",
+                          "query": {"match": {"comments.text": "alpha"}}}},
+     "size": 30},
+    {"query": {"fuzzy": {"body": "alpa"}}, "size": 30},
+    {"size": 0, "aggs": {"tags": {"terms": {"field": "tag", "size": 10}},
+                         "s": {"sum": {"field": "n"}}},
+     "track_total_hits": True},
+    {"knn": {"field": "emb", "query_vector": [0.5, -0.2, 0.1, 0.9], "k": 5},
+     "size": 5},
+]
+
+
+def results(svc, body):
+    r = svc._search_dense(dict(body))
+    hits = [(h["_id"], None if h.get("_score") is None
+             else round(h["_score"], 5)) for h in r["hits"]["hits"]]
+    return hits, r["hits"].get("total"), r.get("aggregations")
+
+
+def test_columnar_merge_preserves_all_results():
+    """After merging, results must equal a clean single-segment index of
+    the LIVE docs (merges expunge deletes, so stats legitimately shift vs
+    the pre-merge multi-segment view — Lucene semantics)."""
+    svc = make_service()
+    assert svc.shards[0].segment_count() > 1
+    # reference: reindex the live docs in merged order, one refresh
+    engine = svc.shards[0]
+    meta = IndexMetadata(index="m", uuid="u2", settings=Settings({}),
+                         mappings=MAPPINGS)
+    ref = IndexService(meta)
+    for seg, keep in zip(engine._segments, engine._live):
+        for ord_ in range(seg.n_docs):
+            if keep[ord_]:
+                ref.index_doc(seg.doc_ids[ord_], seg.sources[ord_])
+    ref.refresh()
+
+    svc.force_merge(1)
+    assert svc.shards[0].segment_count() == 1
+    for q in QUERIES:
+        a = results(svc, q)
+        b = results(ref, q)
+        assert a[0] == b[0] and a[2] == b[2], f"merge changed results for {q}"
+        assert a[1] == b[1]
+    ref.close()
+    # writes continue after merge: update + delete against merged entries
+    svc.index_doc("5", {"body": "alpha fresh", "tag": "g0", "n": 1})
+    svc.delete_doc("8")
+    svc.refresh()
+    r = svc.search({"query": {"match": {"body": "fresh"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["5"]
+    assert svc.get_doc("8") is None
+    svc.close()
+
+
+def test_merge_matches_reparse_builder_exactly():
+    """The columnar merge must produce the SAME postings as re-parsing all
+    live docs through the mapper (the previous merge implementation)."""
+    from elasticsearch_tpu.index.segment import SegmentBuilder, merge_segments
+
+    svc = make_service(seed=11, n_docs=120, refresh_every=40)
+    engine = svc.shards[0]
+    segments, live = engine._segments, engine._live
+    merged = merge_segments(segments, live, seg_id=99)
+
+    builder = SegmentBuilder(seg_id=99)
+    for seg, keep in zip(segments, live):
+        for ord_ in range(seg.n_docs):
+            if keep[ord_]:
+                doc = svc.mapper.parse(seg.doc_ids[ord_], seg.sources[ord_])
+                builder.add(doc, seq_no=int(seg.seq_nos[ord_]),
+                            version=int(seg.versions[ord_]))
+    ref = builder.build()
+
+    assert merged.doc_ids == ref.doc_ids
+    np.testing.assert_array_equal(merged.seq_nos, ref.seq_nos)
+    for field in ref.postings:
+        mf, rf = merged.postings[field], ref.postings[field]
+        live_terms = [t for t in rf.terms if rf.doc_freq[rf.term_to_ord[t]] > 0]
+        merged_live = [t for t in mf.terms if mf.doc_freq[mf.term_to_ord[t]] > 0]
+        assert merged_live == live_terms, field
+        np.testing.assert_array_equal(mf.doc_len, rf.doc_len)
+        for t in live_terms:
+            om, orf = mf.term_to_ord[t], rf.term_to_ord[t]
+            assert mf.doc_freq[om] == rf.doc_freq[orf], (field, t)
+            assert mf.total_term_freq[om] == rf.total_term_freq[orf]
+            lo_m, hi_m = int(mf.post_start[om]), int(mf.post_start[om + 1])
+            lo_r, hi_r = int(rf.post_start[orf]), int(rf.post_start[orf + 1])
+            np.testing.assert_array_equal(mf.post_doc[lo_m:hi_m],
+                                          rf.post_doc[lo_r:hi_r])
+            for j in range(hi_m - lo_m):
+                np.testing.assert_array_equal(
+                    mf.pos_data[int(mf.pos_start[lo_m + j]):
+                                int(mf.pos_start[lo_m + j + 1])],
+                    rf.pos_data[int(rf.pos_start[lo_r + j]):
+                                int(rf.pos_start[lo_r + j + 1])],
+                    err_msg=f"{field}/{t} posting {j}")
+    for field in ref.numeric:
+        mn, rn = merged.numeric[field], ref.numeric[field]
+        np.testing.assert_array_equal(mn.values, rn.values)
+        np.testing.assert_array_equal(mn.exists, rn.exists)
+        np.testing.assert_array_equal(mn.all_values, rn.all_values)
+        np.testing.assert_array_equal(mn.value_start, rn.value_start)
+    for field in ref.keyword:
+        mk, rk = merged.keyword[field], ref.keyword[field]
+        # compare per-doc TERM LISTS (dictionary ord layouts may differ)
+        for d in range(merged.n_docs):
+            assert mk.doc_terms(d) == rk.doc_terms(d), (field, d)
+    for field in ref.geo:
+        mg, rg = merged.geo[field], ref.geo[field]
+        np.testing.assert_array_equal(mg.lat, rg.lat)
+        np.testing.assert_array_equal(mg.lon, rg.lon)
+        np.testing.assert_array_equal(mg.value_start, rg.value_start)
+    for field in ref.vectors:
+        np.testing.assert_array_equal(merged.vectors[field].vectors,
+                                      ref.vectors[field].vectors)
+    for field in ref.nested:
+        mt, rt = merged.nested[field], ref.nested[field]
+        np.testing.assert_array_equal(mt.parent_of, rt.parent_of)
+        np.testing.assert_array_equal(mt.child_start, rt.child_start)
+        assert mt.child.sources == rt.child.sources
+    svc.close()
+
+
+def test_merge_drops_dead_only_terms():
+    """Review r3 finding: terms whose only postings were deleted must not
+    survive merges (they would accumulate across merge generations)."""
+    meta = IndexMetadata(index="dt", uuid="u", settings=Settings({}),
+                         mappings={"properties": {
+                             "body": {"type": "text"},
+                             "tag": {"type": "keyword"}}})
+    svc = IndexService(meta)
+    svc.index_doc("1", {"body": "unique_zombie_term here", "tag": "onlyme"})
+    svc.refresh()
+    svc.index_doc("2", {"body": "normal words here", "tag": "keepme"})
+    svc.refresh()
+    svc.delete_doc("1")
+    svc.refresh()
+    assert svc.shards[0].segment_count() == 2
+    svc.force_merge(1)
+    seg = svc.shards[0].acquire_searcher().views[0].segment
+    assert "unique_zombie_term" not in seg.postings["body"].term_to_ord
+    assert "here" in seg.postings["body"].term_to_ord
+    assert "onlyme" not in seg.keyword["tag"].term_to_ord
+    assert "keepme" in seg.keyword["tag"].term_to_ord
+    svc.close()
