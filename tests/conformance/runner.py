@@ -77,6 +77,9 @@ API_TABLE: Dict[str, Tuple[str, str]] = {
     "snapshot.delete": ("DELETE", "/_snapshot/{repository}/{snapshot}"),
     "snapshot.restore": ("POST", "/_snapshot/{repository}/{snapshot}/_restore"),
     "info": ("GET", "/"),
+    "reindex": ("POST", "/_reindex"),
+    "field_caps": ("POST", "/{index}/_field_caps"),
+    "explain": ("POST", "/{index}/_explain/{id}"),
 }
 
 _NDJSON_APIS = {"bulk", "msearch"}
