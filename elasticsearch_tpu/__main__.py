@@ -30,6 +30,11 @@ def main(argv=None) -> int:
                 data_path=args.data, node_name=args.name)
     rc = RestController()
     register_handlers(node, rc)
+    from elasticsearch_tpu.plugins import load_plugins
+
+    loaded = load_plugins(node, rc)
+    if loaded:
+        print(f"[{args.name}] plugins loaded: {', '.join(loaded)}", flush=True)
     server = HttpServer(rc, host=args.host, port=args.port)
     server.start()
     print(f"[{args.name}] started, http on {args.host}:{server.port}", flush=True)

@@ -40,6 +40,7 @@ class Node:
         from elasticsearch_tpu.tasks import TaskManager
 
         self.tasks = TaskManager(self.node_id)
+        self._async_searches: Dict[str, dict] = {}
         from elasticsearch_tpu.ingest import IngestService
 
         self.ingest = IngestService()

@@ -84,6 +84,11 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("POST", "/{index}/_pit", h.open_pit)
     r("DELETE", "/_pit", h.close_pit)
     r("POST", "/_reindex", h.reindex)
+    r("GET", "/{index}/_rank_eval", h.rank_eval)
+    r("POST", "/{index}/_rank_eval", h.rank_eval)
+    r("POST", "/{index}/_async_search", h.async_search_submit)
+    r("GET", "/_async_search/{id}", h.async_search_get)
+    r("DELETE", "/_async_search/{id}", h.async_search_delete)
     r("GET", "/_field_caps", h.field_caps)
     r("POST", "/_field_caps", h.field_caps)
     r("GET", "/{index}/_field_caps", h.field_caps)
@@ -550,6 +555,176 @@ class _Handlers:
         body = dict(req.body or {})
         ok = self.node.indices.close_pit(body.get("id", ""))
         return _ok({"succeeded": ok, "num_freed": int(ok)})
+
+    # ---------- rank_eval (ref: modules/rank-eval RankEvalPlugin) ----------
+
+    def rank_eval(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        names = self._resolve(req.param("index"), require=True)
+        metric_spec = body.get("metric", {"precision": {}})
+        if not isinstance(metric_spec, dict) or len(metric_spec) != 1:
+            raise IllegalArgumentError(
+                "[metric] must name exactly one metric")
+        (mname, mparams), = metric_spec.items()
+        mparams = mparams or {}
+        k = int(mparams.get("k", 10))
+        details = {}
+        scores = []
+        for r in body.get("requests", []):
+            rid = r["id"]
+            rated = {(d["_index"], d["_id"]): int(d["rating"])
+                     for d in r.get("ratings", [])}
+            request = dict(r.get("request") or {})
+            request.setdefault("size", k)
+            if len(names) == 1:
+                resp = self.node.indices.get(names[0]).search(request)
+            else:
+                resp = self._multi_index_search(names, request,
+                                                "query_then_fetch")
+            hits = resp["hits"]["hits"][:k]
+            hit_rated = [rated.get((h["_index"], h["_id"]), None)
+                         for h in hits]
+            rel_thresh = int(mparams.get("relevant_rating_threshold", 1))
+            relevant = [x is not None and x >= rel_thresh for x in hit_rated]
+            if mname == "precision":
+                denom = len(hits) if not mparams.get(
+                    "ignore_unlabeled") else sum(
+                    1 for x in hit_rated if x is not None)
+                score = (sum(relevant) / denom) if denom else 0.0
+            elif mname == "recall":
+                total_rel = sum(1 for v in rated.values() if v >= rel_thresh)
+                score = (sum(relevant) / total_rel) if total_rel else 0.0
+            elif mname == "mean_reciprocal_rank":
+                score = 0.0
+                for i, ok in enumerate(relevant):
+                    if ok:
+                        score = 1.0 / (i + 1)
+                        break
+            elif mname == "dcg":
+                import math
+
+                # ref: DiscountedCumulativeGain — exponential gain
+                score = sum((2 ** (x or 0) - 1) / math.log2(i + 2)
+                            for i, x in enumerate(hit_rated))
+            else:
+                raise IllegalArgumentError(f"unknown metric [{mname}]")
+            scores.append(score)
+            details[rid] = {
+                "metric_score": score,
+                "unrated_docs": [{"_index": h["_index"], "_id": h["_id"]}
+                                 for h, x in zip(hits, hit_rated)
+                                 if x is None],
+                "hits": [{"hit": {"_index": h["_index"], "_id": h["_id"],
+                                  "_score": h.get("_score")},
+                          "rating": x} for h, x in zip(hits, hit_rated)],
+            }
+        return _ok({"metric_score": (sum(scores) / len(scores)) if scores
+                    else 0.0, "details": details, "failures": {}})
+
+    # ---------- async search (ref: x-pack async-search) ----------
+
+    _ASYNC_KEEP_S = 300.0
+    _ASYNC_MAX = 100
+
+    def _async_store(self):
+        """Created eagerly in Node.__init__ (lazy creation would race under
+        the threaded HTTP server); completed entries expire after keep-alive
+        and the store is size-capped (the reference expires via keep_alive)."""
+        import time as _time
+
+        store = self.node._async_searches
+        now = _time.monotonic()
+        dead = [k for k, v in list(store.items())
+                if not v["is_running"] and v.get("expires_at", 0) < now]
+        for k in dead:
+            store.pop(k, None)
+        while len(store) > self._ASYNC_MAX:
+            store.pop(next(iter(store)), None)
+        return store
+
+    def async_search_submit(self, req: RestRequest) -> RestResponse:
+        import threading as _t
+        import time as _time
+        import uuid as _uuid
+
+        names = self._resolve(req.param("index"), require=True)
+        body = dict(req.body or {})
+        wait_ms = 0
+        if req.param("wait_for_completion_timeout") is not None:
+            from elasticsearch_tpu.tasks.task_manager import parse_timeout_ms
+
+            wait_ms = parse_timeout_ms(
+                req.param("wait_for_completion_timeout")) or 0
+        sid = _uuid.uuid4().hex
+        task = self.node.tasks.register("indices:data/read/async_search",
+                                        f"async[{','.join(names)}]")
+        entry = {"is_running": True, "is_partial": True, "response": None,
+                 "error": None, "start": int(_time.time() * 1000),
+                 "task": task, "done": _t.Event()}
+        self._async_store()[sid] = entry
+
+        def run():
+            try:
+                if len(names) == 1:
+                    entry["response"] = self.node.indices.get(
+                        names[0]).search(body, task=task)
+                else:
+                    entry["response"] = self._multi_index_search(
+                        names, body, "query_then_fetch", task=task)
+            except ElasticsearchTpuError as e:
+                entry["error"] = e
+            except Exception as e:  # noqa: BLE001 — a failed search must
+                err = ElasticsearchTpuError(str(e))   # never report success
+                err.status = 500
+                entry["error"] = err
+            finally:
+                import time as _tt
+
+                entry["is_running"] = False
+                entry["is_partial"] = entry["response"] is None
+                entry["expires_at"] = _tt.monotonic() + self._ASYNC_KEEP_S
+                self.node.tasks.unregister(task)
+                entry["done"].set()
+
+        _t.Thread(target=run, daemon=True,
+                  name=f"async-search-{sid[:8]}").start()
+        if wait_ms:
+            entry["done"].wait(wait_ms / 1000.0)
+        return self._async_render(sid, entry)
+
+    def _async_render(self, sid, entry) -> RestResponse:
+        if entry["error"] is not None:
+            e = entry["error"]
+            return RestResponse(status=e.status,
+                               body={"error": e.to_dict(), "id": sid})
+        return _ok({
+            "id": sid,
+            "is_running": entry["is_running"],
+            "is_partial": entry["is_running"] or entry["response"] is None,
+            "start_time_in_millis": entry["start"],
+            "response": entry["response"] or {
+                "hits": {"total": {"value": 0, "relation": "gte"},
+                         "hits": []}},
+        })
+
+    def async_search_get(self, req: RestRequest) -> RestResponse:
+        entry = self._async_store().get(req.param("id"))
+        if entry is None:
+            e = ElasticsearchTpuError(
+                f"async search [{req.param('id')}] not found")
+            e.status = 404
+            raise e
+        return self._async_render(req.param("id"), entry)
+
+    def async_search_delete(self, req: RestRequest) -> RestResponse:
+        entry = self._async_store().pop(req.param("id"), None)
+        if entry is None:
+            e = ElasticsearchTpuError("not found")
+            e.status = 404
+            raise e
+        if entry["is_running"]:
+            entry["task"].cancel("async search deleted")
+        return _ok({"acknowledged": True})
 
     # ---------- reindex / field_caps / explain ----------
 

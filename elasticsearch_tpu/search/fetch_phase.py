@@ -107,6 +107,9 @@ def execute_fetch_phase(
             hit["_source"] = src
         if fields_spec:
             hit["fields"] = _fetch_fields(seg, h.ord, fields_spec)
+        if request.get("script_fields"):
+            sf = _script_fields(seg, h.ord, request["script_fields"])
+            hit.setdefault("fields", {}).update(sf)
         if h.sort_values is not None:
             hit["sort"] = [s.s if hasattr(s, "s") else s for s in h.sort_values]
         if hl_query is not None:
@@ -188,6 +191,43 @@ def _render_inner_hits(searcher, h: ShardHit, inner_specs, mapper,
                 "_source": nt.child.sources[i],
             } for i in shown],
         }}
+    return out
+
+
+def _script_fields(seg, ord_: int, spec: dict) -> dict:
+    """ref: fetch/subphase/ScriptFieldsPhase — sandboxed expressions over
+    doc values (numeric/keyword columns) and params."""
+    from elasticsearch_tpu.script.expressions import _DocField, compile_script
+
+    class _LazyDoc(dict):
+        """doc['field'] materializes only the columns a script touches."""
+
+        def __missing__(self, fname):
+            col = seg.numeric.get(fname)
+            if col is not None:
+                if col.exists[ord_]:
+                    lo = int(col.value_start[ord_])
+                    hi = int(col.value_start[ord_ + 1])
+                    vals = [float(v) for v in col.all_values[lo:hi]]
+                else:
+                    vals = []
+            else:
+                kc = seg.keyword.get(fname)
+                vals = kc.doc_terms(ord_) \
+                    if kc is not None and kc.exists[ord_] else []
+            f = _DocField(vals)
+            self[fname] = f
+            return f
+
+    out = {}
+    doc = _LazyDoc()
+    for name, body in spec.items():
+        script_spec = body.get("script", body) if isinstance(body, dict) else body
+        script = compile_script(script_spec)
+        params = script_spec.get("params", {}) \
+            if isinstance(script_spec, dict) else {}
+        value = script.execute({"doc": doc, "params": params})
+        out[name] = value if isinstance(value, list) else [value]
     return out
 
 

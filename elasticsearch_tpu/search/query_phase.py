@@ -178,6 +178,9 @@ def execute_query_phase(
             scores = scores + ks_dev
             mask = mask | km_dev if query is not None else km_dev
         mask = mask & leaf.live_dev()
+        slice_spec = request.get("slice")
+        if slice_spec is not None:
+            mask = mask & jnp.asarray(_slice_mask(leaf, slice_spec))
         if min_score is not None:
             mask = mask & (scores >= float(min_score))
         total += int(jnp.sum(mask.astype(jnp.int32)))
@@ -259,6 +262,27 @@ def execute_query_phase(
                              max_score=max_score, aggregations=agg_partials,
                              timed_out=deadline.timed_out,
                              terminated_early=terminated_early)
+
+
+def _slice_mask(leaf, slice_spec) -> np.ndarray:
+    """Sliced scroll (ref P11: SliceBuilder — hash(_id) % max == id splits
+    a scan into independent workers). CRC32 of the doc id: stable across
+    processes, cached per (segment, max)."""
+    import zlib
+
+    sid = int(slice_spec.get("id", 0))
+    smax = int(slice_spec.get("max", 1))
+    if smax < 1 or not (0 <= sid < smax):
+        raise IllegalArgumentError(
+            f"slice id [{sid}] must be in [0, max [{smax}])")
+    seg = leaf.segment
+    key = f"slicemod:{smax}"
+    mods = seg._device.get(key)
+    if mods is None:
+        mods = np.asarray([zlib.crc32(d.encode()) % smax
+                           for d in seg.doc_ids], np.int32)
+        seg._device[key] = mods
+    return mods == sid
 
 
 def collapse_value(seg, ord_: int, field: str):

@@ -1,0 +1,139 @@
+"""Sliced scroll, script_fields, rank_eval, async search, plugin SPI."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest import RestController, register_handlers
+
+
+@pytest.fixture()
+def env():
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+
+    def call(method, path, body=None, params=None, raw=None):
+        data = raw if raw is not None else (
+            json.dumps(body).encode() if body is not None else None)
+        resp = rc.dispatch(method, path, params or {}, data)
+        return resp.status, json.loads(resp.encode() or b"{}")
+
+    yield node, call
+    node.close()
+
+
+def fill(call, n=90):
+    call("PUT", "/t", {"mappings": {"properties": {
+        "body": {"type": "text"}, "n": {"type": "integer"},
+        "tag": {"type": "keyword"}}}})
+    for i in range(n):
+        call("PUT", f"/t/_doc/{i}", {"body": f"w{i % 4} common",
+                                     "n": i, "tag": f"g{i % 3}"})
+    call("POST", "/t/_refresh")
+
+
+def test_sliced_search_partitions_completely(env):
+    node, call = env
+    fill(call)
+    seen = []
+    for sid in range(3):
+        st, r = call("POST", "/t/_search", {
+            "query": {"match_all": {}}, "size": 90,
+            "slice": {"id": sid, "max": 3}, "track_total_hits": True})
+        assert st == 200
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        seen.extend(ids)
+        assert 0 < len(ids) < 90          # a real split
+    assert sorted(seen, key=int) == [str(i) for i in range(90)]
+    # invalid slice id rejected
+    st, _ = call("POST", "/t/_search", {"query": {"match_all": {}},
+                                        "slice": {"id": 3, "max": 3}})
+    assert st == 400
+
+
+def test_script_fields(env):
+    node, call = env
+    fill(call, n=5)
+    st, r = call("POST", "/t/_search", {
+        "query": {"term": {"n": 3}},
+        "script_fields": {
+            "doubled": {"script": {"source": "doc['n'].value * 2"}},
+            "biased": {"script": {"source": "doc['n'].value + params.b",
+                                  "params": {"b": 100}}}}})
+    assert st == 200
+    f = r["hits"]["hits"][0]["fields"]
+    assert f["doubled"] == [6.0] and f["biased"] == [103.0]
+
+
+def test_rank_eval(env):
+    node, call = env
+    fill(call)
+    body = {
+        "requests": [{
+            "id": "q1",
+            "request": {"query": {"match": {"body": "w1"}}},
+            "ratings": [{"_index": "t", "_id": "1", "rating": 1},
+                        {"_index": "t", "_id": "5", "rating": 1},
+                        {"_index": "t", "_id": "2", "rating": 0}],
+        }],
+        "metric": {"precision": {"k": 5}},
+    }
+    st, r = call("POST", "/t/_rank_eval", body)
+    assert st == 200
+    assert 0.0 < r["metric_score"] <= 1.0
+    d = r["details"]["q1"]
+    assert d["metric_score"] == r["metric_score"]
+    assert any(h["rating"] == 1 for h in d["hits"])
+    st, r = call("POST", "/t/_rank_eval", {
+        "requests": body["requests"],
+        "metric": {"mean_reciprocal_rank": {"k": 5}}})
+    assert r["metric_score"] == 1.0      # first hit is rated relevant
+
+
+def test_async_search_lifecycle(env):
+    node, call = env
+    fill(call)
+    st, r = call("POST", "/t/_async_search",
+                 {"query": {"match": {"body": "common"}},
+                  "track_total_hits": True},
+                 params={"wait_for_completion_timeout": "10s"})
+    assert st == 200
+    sid = r["id"]
+    assert r["is_running"] is False and r["is_partial"] is False
+    assert r["response"]["hits"]["total"]["value"] == 90
+    st, r2 = call("GET", f"/_async_search/{sid}")
+    assert st == 200 and r2["response"]["hits"]["total"]["value"] == 90
+    st, _ = call("DELETE", f"/_async_search/{sid}")
+    assert st == 200
+    st, _ = call("GET", f"/_async_search/{sid}")
+    assert st == 404
+
+
+def test_plugin_spi(tmp_path, monkeypatch):
+    import sys
+
+    plug = tmp_path / "demo_plugin.py"
+    plug.write_text(
+        "def install(node, rc=None):\n"
+        "    node.ingest.put_pipeline('from-plugin', {'processors': [\n"
+        "        {'set': {'field': 'via', 'value': 'plugin'}}]})\n"
+        "    node.plugin_touched = True\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setenv("ES_TPU_PLUGINS", "demo_plugin")
+    from elasticsearch_tpu.plugins import PluginError, load_plugins
+
+    node = Node()
+    loaded = load_plugins(node)
+    assert loaded == ["demo_plugin"] and node.plugin_touched
+    assert node.ingest.has("from-plugin")
+    node.close()
+
+    monkeypatch.setenv("ES_TPU_PLUGINS", "no_such_module_xyz")
+    node2 = Node()
+    with pytest.raises(PluginError):
+        load_plugins(node2)
+    node2.close()
