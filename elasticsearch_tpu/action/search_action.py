@@ -93,7 +93,8 @@ class SearchActionService:
                 pickle.dumps(qr.aggregations)).decode("ascii")
         return {"total": qr.total, "relation": qr.relation,
                 "max_score": _py(qr.max_score), "hits": hits_wire,
-                "context_id": ctx.context_id, "aggs": aggs_b64}
+                "context_id": ctx.context_id, "aggs": aggs_b64,
+                "profile": qr.profile}
 
     def _on_shard_fetch(self, req) -> dict:
         p = req.payload
@@ -329,6 +330,13 @@ class SearchActionService:
             except Exception:  # noqa: BLE001 — reaper collects leftovers
                 pass
 
+        profile = None
+        if body.get("profile"):
+            profile = {"shards": [
+                {"id": f"[{r['_index']}][{r['_shard']}]",
+                 "searches": [{"query": r.get("profile") or [],
+                               "rewrite_time": 0, "collector": []}]}
+                for r in shard_results]}
         resp = {
             "took": int((time.monotonic() - start) * 1000),
             "timed_out": False,
@@ -343,4 +351,6 @@ class SearchActionService:
         finalize_hits_envelope(resp, body)
         if aggs_out is not None:
             resp["aggregations"] = aggs_out
+        if profile is not None:
+            resp["profile"] = profile
         return resp

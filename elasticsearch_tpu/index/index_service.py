@@ -105,7 +105,7 @@ class IndexService:
         on every refresh/delete."""
         import json as _json
 
-        if int(request.get("size", 10)) != 0 or request.get("search_after")                 is not None or "_after_full" in request                 or request.get("_want_cursor") or request.get("timeout"):
+        if int(request.get("size", 10)) != 0 or request.get("search_after")                 is not None or "_after_full" in request                 or request.get("_want_cursor") or request.get("timeout") or request.get("profile"):
             return None
         try:
             body = _json.dumps(request, sort_keys=True)
@@ -138,7 +138,32 @@ class IndexService:
                 if len(self._req_cache) >= self._REQ_CACHE_MAX:
                     self._req_cache.pop(next(iter(self._req_cache)))
                 self._req_cache[key] = _copy.deepcopy(resp)
+        self._maybe_slow_log(request, resp)
         return resp
+
+    def _maybe_slow_log(self, request: dict, resp: dict) -> None:
+        """Search slow log (ref: index/SearchSlowLog.java): queries over
+        index.search.slowlog.threshold.query.{warn,info} log with the
+        request source — the first stop when a query pattern goes bad."""
+        import json as _json
+        import logging
+
+        from elasticsearch_tpu.tasks.task_manager import parse_timeout_ms
+
+        took = resp.get("took", 0)
+        for level in ("warn", "info"):
+            raw = self.meta.settings.raw(
+                f"index.search.slowlog.threshold.query.{level}")
+            if raw is None:
+                continue
+            thresh = parse_timeout_ms(raw)
+            if thresh is not None and took >= thresh:
+                logging.getLogger("index.search.slowlog").log(
+                    logging.WARNING if level == "warn" else logging.INFO,
+                    "[%s] took[%dms], source[%s]", self.name, took,
+                    _json.dumps({k: v for k, v in request.items()
+                                 if not k.startswith("_")})[:1000])
+                break
 
     def msearch(self, requests: List[dict],
                 search_type: str = "query_then_fetch") -> List[dict]:
@@ -158,7 +183,8 @@ class IndexService:
                 results.append(r)
                 continue
             try:
-                results.append(self._search_dense(requests[i], search_type))
+                # public entry: request cache + slow log apply to msearch too
+                results.append(self.search(requests[i], search_type))
             except ElasticsearchTpuError as e:
                 results.append(e)
         return results
@@ -273,6 +299,12 @@ class IndexService:
             resp["aggregations"] = aggs
         if any(r.terminated_early for r in shard_results):
             resp["terminated_early"] = True
+        if request.get("profile"):
+            resp["profile"] = {"shards": [
+                {"id": f"[{self.name}][{sid}]",
+                 "searches": [{"query": r.profile or [],
+                               "rewrite_time": 0, "collector": []}]}
+                for sid, r in enumerate(shard_results)]}
         if cursor is not None:
             resp["_cursor"] = cursor
         return resp

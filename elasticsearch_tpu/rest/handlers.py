@@ -132,6 +132,7 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/_cluster/stats", h.cluster_stats)
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
+    r("GET", "/_nodes/hot_threads", h.hot_threads)
     # aliases
     r("POST", "/_aliases", h.update_aliases)
     r("GET", "/_alias", h.get_aliases)
@@ -555,6 +556,22 @@ class _Handlers:
         body = dict(req.body or {})
         ok = self.node.indices.close_pit(body.get("id", ""))
         return _ok({"succeeded": ok, "num_freed": int(ok)})
+
+    def hot_threads(self, req: RestRequest) -> RestResponse:
+        """ref: RestNodesHotThreadsAction — live thread stack dump, the
+        first tracing stop for a wedged node."""
+        import sys
+        import threading as _t
+        import traceback
+
+        names = {t.ident: t.name for t in _t.enumerate()}
+        lines = [f"::: {{{self.node.node_name}}}{{{self.node.node_id}}}"]
+        for tid, frame in sys._current_frames().items():
+            lines.append(f"\n   thread [{names.get(tid, tid)}] id [{tid}]:")
+            lines.extend("     " + ln.rstrip() for ln in
+                         traceback.format_stack(frame)[-12:])
+        return RestResponse(status=200, body="\n".join(lines) + "\n",
+                            content_type="text/plain")
 
     # ---------- rank_eval (ref: modules/rank-eval RankEvalPlugin) ----------
 

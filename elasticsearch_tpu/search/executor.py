@@ -192,6 +192,47 @@ def leaves(searcher: EngineSearcher) -> List[LeafContext]:
 # --------------------------------------------------------------------------
 
 
+class QueryProfiler:
+    """Per-query-node timing tree (ref: QueryProfiler/ProfileResult):
+    nested executes stack; children attach under their parent. Timings
+    include device dispatch + sync for that node's work (the TPU analog of
+    the reference's per-Weight/Scorer breakdown)."""
+
+    def __init__(self):
+        self.roots: List[dict] = []
+        self._stack: List[dict] = []
+
+    def push(self, query) -> dict:
+        # MERGE by (type, description): one tree per query, timings
+        # aggregated across leaves/segments (the reference reports one
+        # ProfileResult tree per query per shard)
+        key = (type(query).__name__, repr(query)[:200])
+        siblings = (self._stack[-1]["children"] if self._stack
+                    else self.roots)
+        for n in siblings:
+            if (n["type"], n["description"]) == key:
+                self._stack.append(n)
+                return n
+        node = {"type": key[0], "description": key[1],
+                "time_in_nanos": 0, "children": []}
+        siblings.append(node)
+        self._stack.append(node)
+        return node
+
+    def pop(self) -> None:
+        self._stack.pop()
+
+    def tree(self) -> List[dict]:
+        def clean(n):
+            out = {k: v for k, v in n.items() if k != "children" or v}
+            if n["children"]:
+                out["children"] = [clean(c) for c in n["children"]]
+            # parents accumulate children's time too (reference semantics:
+            # self time shown via breakdowns; we report inclusive)
+            return out
+        return [clean(r) for r in self.roots]
+
+
 class QueryExecutor:
     def __init__(self, mapper: MapperService, stats: ShardStats):
         self.mapper = mapper
@@ -199,6 +240,9 @@ class QueryExecutor:
         # cooperative cancellation hook (ref: ContextIndexSearcher.java:66
         # addQueryCancellation) — set by the query phase when a Task exists
         self.check = None
+        # query profiler (ref: search/profile/query/QueryProfiler.java) —
+        # set by the query phase when the request asks for profile: true
+        self.profiler = None
 
     def execute(self, query: q.Query, leaf: LeafContext):
         """Returns (scores f32[n], mask bool[n]) device arrays."""
@@ -210,7 +254,23 @@ class QueryExecutor:
         method = getattr(self, f"_exec_{type(query).__name__}", None)
         if method is None:
             raise ParsingError(f"unsupported query [{type(query).__name__}]")
-        scores, mask = method(query, leaf)
+        if self.profiler is not None:
+            import time as _time
+
+            import jax as _jax
+
+            node = self.profiler.push(query)
+            t0 = _time.monotonic_ns()
+            try:
+                scores, mask = method(query, leaf)
+                # profiling must attribute DEVICE time to the node that
+                # dispatched it, not to whoever later forces the sync
+                _jax.block_until_ready((scores, mask))
+            finally:
+                node["time_in_nanos"] += _time.monotonic_ns() - t0
+                self.profiler.pop()
+        else:
+            scores, mask = method(query, leaf)
         boost = getattr(query, "boost", 1.0)
         if boost != 1.0:
             scores = scores * boost

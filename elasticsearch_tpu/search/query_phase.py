@@ -43,6 +43,7 @@ class QuerySearchResult:
     aggregations: Optional[dict] = None
     timed_out: bool = False
     terminated_early: bool = False
+    profile: Optional[list] = None
 
 
 def parse_sort(sort_spec) -> List[Tuple[str, str]]:
@@ -78,6 +79,12 @@ def execute_query_phase(
     ex = executor or QueryExecutor(mapper, stats)
     if task is not None:
         ex.check = task.check
+    profiler = None
+    if request.get("profile"):
+        from elasticsearch_tpu.search.executor import QueryProfiler
+
+        profiler = QueryProfiler()
+        ex.profiler = profiler
     deadline = Deadline(parse_timeout_ms(request.get("timeout")))
     terminate_after = request.get("terminate_after") or None  # 0 = not set
     terminated_early = False
@@ -261,7 +268,8 @@ def execute_query_phase(
     return QuerySearchResult(total=total, relation=relation, hits=window,
                              max_score=max_score, aggregations=agg_partials,
                              timed_out=deadline.timed_out,
-                             terminated_early=terminated_early)
+                             terminated_early=terminated_early,
+                             profile=profiler.tree() if profiler else None)
 
 
 def _slice_mask(leaf, slice_spec) -> np.ndarray:

@@ -362,3 +362,18 @@ def test_adaptive_replica_selection_updates_ewma():
         master.search("docs", {"query": {"match": {"body": "common"}}})
     assert svc._node_ewma_ms, "EWMA stats must accumulate"
     assert all(v >= 0 for v in svc._node_ewma_ms.values())
+
+
+def test_distributed_profile_returns_tree():
+    nodes, store, channels = make_cluster()
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(2, 0))
+    a.bulk("docs", bulk_ops(0, 30))
+    a.refresh("docs")
+    r = b.search("docs", {"query": {"match": {"body": "common"}},
+                          "profile": True})
+    shards = r["profile"]["shards"]
+    assert len(shards) == 2
+    q = shards[0]["searches"][0]["query"]
+    assert q and q[0]["type"] == "MatchQuery"
+    assert q[0]["time_in_nanos"] > 0

@@ -137,3 +137,37 @@ def test_plugin_spi(tmp_path, monkeypatch):
     with pytest.raises(PluginError):
         load_plugins(node2)
     node2.close()
+
+
+def test_profile_and_hot_threads(env):
+    node, call = env
+    fill(call, n=30)
+    st, r = call("POST", "/t/_search", {
+        "query": {"bool": {"must": [{"match": {"body": "common"}}],
+                           "filter": [{"term": {"tag": "g1"}}]}},
+        "profile": True})
+    assert st == 200
+    prof = r["profile"]["shards"][0]["searches"][0]["query"]
+    assert prof and prof[0]["type"] == "BoolQuery"
+    kids = {c["type"] for c in prof[0]["children"]}
+    assert {"MatchQuery", "TermQuery"} <= kids
+    assert all(c["time_in_nanos"] >= 0 for c in prof[0]["children"])
+    # hot_threads is text/plain — dispatch directly
+    rc = RestController()
+    register_handlers(node, rc)
+    raw = rc.dispatch("GET", "/_nodes/hot_threads", {}, None)
+    assert raw.status == 200 and b"thread [" in raw.encode()
+
+
+def test_search_slow_log(env, caplog):
+    import logging
+
+    node, call = env
+    call("PUT", "/slow", {"settings": {"index": {
+        "search": {"slowlog": {"threshold": {"query": {"warn": "0ms"}}}}}}})
+    call("PUT", "/slow/_doc/1", {"x": "hello world"})
+    call("POST", "/slow/_refresh")
+    with caplog.at_level(logging.WARNING, logger="index.search.slowlog"):
+        call("POST", "/slow/_search", {"query": {"match": {"x": "hello"}}})
+    assert any("took" in rec.message or "took" in rec.getMessage()
+               for rec in caplog.records), caplog.records
