@@ -408,6 +408,7 @@ class IndicesService:
         self._lock = threading.Lock()
         # PIT/scroll contexts + keepalive reaper (ref: SearchService.Reaper)
         self.contexts = ReaderContextRegistry()
+        self.templates: Dict[str, dict] = {}
         self._reaper_stop = threading.Event()
         self._reaper: Optional[threading.Thread] = None
 
@@ -449,8 +450,86 @@ class IndicesService:
             ctx.keep_alive_s = keep_alive_s
         return self.get(ctx.index).scroll_continue(ctx, task=task)
 
+    # ---- index templates (ref: cluster/metadata/
+    #      MetadataIndexTemplateService.java — composable v2 templates).
+    #      NOTE: node-local registry; the multi-node control plane
+    #      (cluster_node.create_index) does not replicate templates yet —
+    #      replicating them through cluster-state metadata is the follow-up ----
+
+    def put_template(self, name: str, body: dict) -> None:
+        patterns = body.get("index_patterns")
+        if not patterns:
+            from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+            raise IllegalArgumentError("index template must specify "
+                                       "index_patterns")
+        if isinstance(patterns, str):
+            patterns = [patterns]
+        try:
+            priority = int(body.get("priority", 0))
+        except (TypeError, ValueError):
+            from elasticsearch_tpu.common.errors import IllegalArgumentError
+
+            raise IllegalArgumentError(
+                f"[priority] must be an integer, got "
+                f"[{body.get('priority')}]")
+        with self._lock:
+            self.templates[name] = {
+                "index_patterns": patterns,
+                "priority": priority,
+                "template": body.get("template", {}),
+            }
+
+    def delete_template(self, name: str) -> None:
+        with self._lock:
+            if self.templates.pop(name, None) is None:
+                from elasticsearch_tpu.common.errors import (
+                    ElasticsearchTpuError,
+                )
+
+                e = ElasticsearchTpuError(
+                    f"index template [{name}] missing")
+                e.status = 404
+                raise e
+
+    def _apply_templates(self, name: str, settings: Settings,
+                         mappings: dict, aliases: Dict[str, dict]):
+        """Highest-priority matching template underlays request values
+        (request wins on conflicts, ref: composable template resolution)."""
+        import fnmatch
+
+        with self._lock:   # puts/deletes mutate under the same lock
+            candidates = list(self.templates.values())
+        matches = sorted(
+            (t for t in candidates
+             if any(fnmatch.fnmatchcase(name, p)
+                    for p in t["index_patterns"])),
+            key=lambda t: t["priority"], reverse=True)
+        if not matches:
+            return settings, mappings, aliases
+        tpl = matches[0]["template"]
+        tpl_settings = Settings(tpl.get("settings", {}))
+        merged_settings = {k: tpl_settings.raw(k) for k in tpl_settings}
+        # bare topology keys normalize to their index.-prefixed forms (the
+        # same normalization Node.create_index applies to request bodies)
+        for bare in ("number_of_shards", "number_of_replicas",
+                     "default_pipeline"):
+            if bare in merged_settings and \
+                    f"index.{bare}" not in merged_settings:
+                merged_settings[f"index.{bare}"] = merged_settings.pop(bare)
+        for k in settings:
+            merged_settings[k] = settings.raw(k)
+        tpl_maps = dict(tpl.get("mappings", {}).get("properties", {}))
+        tpl_maps.update((mappings or {}).get("properties", {}))
+        merged_mappings = {"properties": tpl_maps} if tpl_maps else (mappings or {})
+        merged_aliases = dict(tpl.get("aliases", {}))
+        merged_aliases.update(aliases or {})
+        return Settings(merged_settings), merged_mappings, merged_aliases
+
     def create_index(self, name: str, settings: Settings, mappings: dict,
                      aliases: Dict[str, dict] | None = None) -> IndexMetadata:
+        settings, mappings, aliases = self._apply_templates(
+            name, settings, mappings, aliases or {})
         with self._lock:
             if name in self._indices:
                 raise ResourceAlreadyExistsError(f"index [{name}] already exists", index=name)

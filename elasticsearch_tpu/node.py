@@ -35,7 +35,33 @@ class Node:
             nodes={self.node_id: node},
         )
         self.breakers = HierarchyCircuitBreakerService()
+        from elasticsearch_tpu.common.settings import ClusterSettings, Setting
+
+        # dynamic cluster settings registry (ref: ClusterSettings + the
+        # settings ActionModule exposes over /_cluster/settings)
+        # every registered setting has a LIVE consumer below — an update
+        # API that silently ignores values would be worse than none
+        s_keep = Setting("search.default_keep_alive", "5m", str, dynamic=True)
+        s_buckets = Setting("search.max_buckets", 65536, int, dynamic=True)
+        s_auto = Setting("action.auto_create_index", True,
+                         lambda v: str(v).lower() != "false", dynamic=True)
+        self.cluster_settings = ClusterSettings(
+            self.settings, [s_keep, s_buckets, s_auto])
+        self._persistent_settings: dict = {}
+        self._transient_settings: dict = {}
+        self.auto_create_index = True
         self.indices = IndicesService(data_path, breakers=self.breakers)
+        from elasticsearch_tpu.index.index_service import parse_keep_alive
+        from elasticsearch_tpu.search import aggregations as _aggs
+
+        self.cluster_settings.add_settings_update_consumer(
+            s_auto, lambda v: setattr(self, "auto_create_index", v))
+        self.cluster_settings.add_settings_update_consumer(
+            s_buckets, lambda v: setattr(_aggs, "MAX_BUCKETS", int(v)))
+        self.cluster_settings.add_settings_update_consumer(
+            s_keep, lambda v: setattr(self.indices.contexts,
+                                      "default_keep_alive_s",
+                                      parse_keep_alive(v)))
         self.transport = TransportService(self.node_id)
         from elasticsearch_tpu.tasks import TaskManager
 

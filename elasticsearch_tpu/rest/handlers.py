@@ -127,6 +127,12 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/{index}/_analyze", h.analyze)
     r("POST", "/{index}/_analyze", h.analyze)
     # cluster / monitoring
+    r("PUT", "/_index_template/{name}", h.put_index_template)
+    r("GET", "/_index_template/{name}", h.get_index_template)
+    r("GET", "/_index_template", h.get_index_templates)
+    r("DELETE", "/_index_template/{name}", h.delete_index_template)
+    r("GET", "/_cluster/settings", h.get_cluster_settings)
+    r("PUT", "/_cluster/settings", h.put_cluster_settings)
     r("GET", "/_cluster/health", h.cluster_health)
     r("GET", "/_cluster/state", h.cluster_state)
     r("GET", "/_cluster/stats", h.cluster_stats)
@@ -282,10 +288,16 @@ class _Handlers:
     def create_doc(self, req: RestRequest) -> RestResponse:
         return self._do_index(req, req.param("id"), op_type="create")
 
+    def _auto_create(self, name: str) -> None:
+        if self.node.indices.has(name):
+            return
+        if not getattr(self.node, "auto_create_index", True):
+            raise IndexNotFoundError(name)
+        self.node.create_index(name, {})  # auto-create (ref: TransportBulkAction)
+
     def _do_index(self, req: RestRequest, doc_id: str, op_type: str) -> RestResponse:
         name = req.param("index")
-        if not self.node.indices.has(name):
-            self.node.create_index(name, {})  # auto-create (ref: TransportBulkAction)
+        self._auto_create(name)
         svc = self.node.indices.get(name)
         kw = {}
         if req.param("if_seq_no") is not None:
@@ -431,8 +443,7 @@ class _Handlers:
                 source = json.loads(lines[i])
                 i += 1
             try:
-                if not self.node.indices.has(index):
-                    self.node.create_index(index, {})
+                self._auto_create(index)
                 svc = self.node.indices.get(index)
                 touched.add(index)
                 if op in ("index", "create"):
@@ -572,6 +583,80 @@ class _Handlers:
                          traceback.format_stack(frame)[-12:])
         return RestResponse(status=200, body="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    # ---------- index templates / cluster settings ----------
+
+    def put_index_template(self, req: RestRequest) -> RestResponse:
+        self.node.indices.put_template(req.param("name"),
+                                       dict(req.body or {}))
+        return _ok({"acknowledged": True})
+
+    def get_index_template(self, req: RestRequest) -> RestResponse:
+        import fnmatch as _fn
+
+        name = req.param("name")
+        out = [{"name": n, "index_template": t}
+               for n, t in self.node.indices.templates.items()
+               if _fn.fnmatchcase(n, name)]
+        if not out and "*" not in name:
+            e = ElasticsearchTpuError(
+                f"index template matching [{name}] not found")
+            e.status = 404
+            raise e
+        return _ok({"index_templates": out})
+
+    def get_index_templates(self, req: RestRequest) -> RestResponse:
+        return _ok({"index_templates": [
+            {"name": n, "index_template": t}
+            for n, t in self.node.indices.templates.items()]})
+
+    def delete_index_template(self, req: RestRequest) -> RestResponse:
+        self.node.indices.delete_template(req.param("name"))
+        return _ok({"acknowledged": True})
+
+    def get_cluster_settings(self, req: RestRequest) -> RestResponse:
+        from elasticsearch_tpu.common.settings import Settings as _S
+
+        out = {"persistent": _S(self.node._persistent_settings).as_nested_dict(),
+               "transient": _S(self.node._transient_settings).as_nested_dict()}
+        if req.param("include_defaults") == "true":
+            out["defaults"] = {
+                s.key: s.get(self.node.cluster_settings.settings)
+                for s in self.node.cluster_settings._registered.values()}
+        return _ok(out)
+
+    def put_cluster_settings(self, req: RestRequest) -> RestResponse:
+        """ref: RestClusterUpdateSettingsAction — validated against the
+        registered dynamic settings; persistent/transient tracked apart."""
+        body = dict(req.body or {})
+        from elasticsearch_tpu.common.settings import Settings as _S
+
+        # validate EVERYTHING before committing anything (the reference
+        # rejects the whole request; partial commits would lie)
+        all_updates = {}
+        for scope in ("persistent", "transient"):
+            flat = _S(body.get(scope) or {})
+            all_updates[scope] = {k: flat.raw(k) for k in flat}
+        for scope, updates in all_updates.items():
+            for key in updates:
+                if key not in self.node.cluster_settings._registered:
+                    raise IllegalArgumentError(
+                        f"{scope} setting [{key}], not recognized")
+        for scope in ("persistent", "transient"):
+            updates = all_updates[scope]
+            if not updates:
+                continue
+            self.node.cluster_settings.apply(updates)
+            store = (self.node._persistent_settings if scope == "persistent"
+                     else self.node._transient_settings)
+            for k, v in updates.items():
+                if v is None:
+                    store.pop(k, None)
+                else:
+                    store[k] = v
+        return _ok({"acknowledged": True,
+                    "persistent": _S(self.node._persistent_settings).as_nested_dict(),
+                    "transient": _S(self.node._transient_settings).as_nested_dict()})
 
     # ---------- rank_eval (ref: modules/rank-eval RankEvalPlugin) ----------
 

@@ -80,6 +80,11 @@ API_TABLE: Dict[str, Tuple[str, str]] = {
     "reindex": ("POST", "/_reindex"),
     "field_caps": ("POST", "/{index}/_field_caps"),
     "explain": ("POST", "/{index}/_explain/{id}"),
+    "indices.put_index_template": ("PUT", "/_index_template/{name}"),
+    "indices.get_index_template": ("GET", "/_index_template/{name}"),
+    "indices.delete_index_template": ("DELETE", "/_index_template/{name}"),
+    "cluster.get_settings": ("GET", "/_cluster/settings"),
+    "cluster.put_settings": ("PUT", "/_cluster/settings"),
 }
 
 _NDJSON_APIS = {"bulk", "msearch"}

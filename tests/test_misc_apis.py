@@ -171,3 +171,38 @@ def test_search_slow_log(env, caplog):
         call("POST", "/slow/_search", {"query": {"match": {"x": "hello"}}})
     assert any("took" in rec.message or "took" in rec.getMessage()
                for rec in caplog.records), caplog.records
+
+
+def test_cluster_settings_consumers_take_effect(env):
+    node, call = env
+    # auto-create off -> writes to missing indices 404
+    st, _ = call("PUT", "/_cluster/settings", {
+        "persistent": {"action.auto_create_index": "false"}})
+    assert st == 200
+    st, _ = call("PUT", "/ghost/_doc/1", {"x": 1})
+    assert st == 404
+    st, _ = call("PUT", "/_cluster/settings", {
+        "persistent": {"action.auto_create_index": "true"}})
+    st, _ = call("PUT", "/ghost/_doc/1", {"x": 1})
+    assert st in (200, 201)
+    # atomic validation: invalid transient leaves valid persistent unapplied
+    st, _ = call("PUT", "/_cluster/settings", {
+        "persistent": {"search.max_buckets": 777},
+        "transient": {"bogus.setting": 1}})
+    assert st == 400
+    st, r = call("GET", "/_cluster/settings")
+    assert "search" not in r["persistent"]
+
+
+def test_template_bare_topology_keys(env):
+    node, call = env
+    st, _ = call("PUT", "/_index_template/bare", {
+        "index_patterns": ["bare-*"],
+        "template": {"settings": {"number_of_shards": 2}}})
+    assert st == 200
+    call("PUT", "/bare-1", {})
+    st, r = call("GET", "/bare-1")
+    assert r["bare-1"]["settings"]["index"]["number_of_shards"] == "2"
+    st, _ = call("PUT", "/_index_template/badprio", {
+        "index_patterns": ["x*"], "priority": "high"})
+    assert st == 400
