@@ -84,6 +84,11 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("POST", "/{index}/_pit", h.open_pit)
     r("DELETE", "/_pit", h.close_pit)
     r("POST", "/_reindex", h.reindex)
+    r("GET", "/{index}/_termvectors/{id}", h.termvectors)
+    r("POST", "/{index}/_termvectors/{id}", h.termvectors)
+    r("POST", "/_render/template", h.render_template)
+    r("GET", "/{index}/_search/template", h.search_template)
+    r("POST", "/{index}/_search/template", h.search_template)
     r("GET", "/{index}/_rank_eval", h.rank_eval)
     r("POST", "/{index}/_rank_eval", h.rank_eval)
     r("POST", "/{index}/_async_search", h.async_search_submit)
@@ -149,6 +154,31 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/_cat/shards", h.cat_shards)
     r("GET", "/_cat/count", h.cat_count)
     r("GET", "/_cat/nodes", h.cat_nodes)
+    r("GET", "/_cat/segments", h.cat_segments)
+    r("GET", "/_cat/segments/{index}", h.cat_segments)
+    r("GET", "/_cat/aliases", h.cat_aliases)
+    r("GET", "/_cat/templates", h.cat_templates)
+
+
+def _render_search_template(source, params: dict):
+    """Mustache subset: {{var}} substitution + {{#toJson}}var{{/toJson}}
+    (the two forms that cover the vast majority of real templates)."""
+    import re as _re
+
+    if isinstance(source, dict):
+        source = json.dumps(source)
+    if not isinstance(source, str):
+        raise IllegalArgumentError("[source] template is required")
+    out = _re.sub(
+        r'"\{\{#toJson\}\}(\w+)\{\{/toJson\}\}"',
+        lambda m: json.dumps(params.get(m.group(1))), source)
+    out = _re.sub(
+        r"\{\{(\w+)\}\}",
+        lambda m: json.dumps(str(params.get(m.group(1), "")))[1:-1], out)
+    try:
+        return json.loads(out)
+    except json.JSONDecodeError as e:
+        raise IllegalArgumentError(f"failed to render template: {e}")
 
 
 def _ok(body, status=200) -> RestResponse:
@@ -583,6 +613,94 @@ class _Handlers:
                          traceback.format_stack(frame)[-12:])
         return RestResponse(status=200, body="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    # ---------- termvectors / templates(search) ----------
+
+    def termvectors(self, req: RestRequest) -> RestResponse:
+        """ref: RestTermVectorsAction — per-field term/freq/position stats
+        for one document. REALTIME: the stored source is re-analyzed
+        through the mapper (exactly what indexing did), so unrefreshed
+        docs work and cost is O(doc terms), not O(vocabulary); df/ttf
+        term statistics come from the postings for just the doc's terms."""
+        names = self._resolve(req.param("index"), require=True)
+        if len(names) != 1:
+            raise IllegalArgumentError(
+                "_termvectors requires exactly one concrete index")
+        name = names[0]
+        doc_id = req.param("id")
+        svc = self.node.indices.get(name)
+        source = svc.get_doc(doc_id)          # realtime (version map)
+        if source is None:
+            raise DocumentMissingError(f"[{doc_id}]: document missing")
+        body = dict(req.body or {})
+        want = body.get("fields") or req.param("fields")
+        if isinstance(want, str):
+            want = want.split(",")
+        parsed = svc.mapper.parse(doc_id, source["_source"]
+                                  if "_source" in source else source)
+        engine = svc.shard_for(doc_id)
+        searcher = engine.acquire_searcher()
+        tv = {}
+        field_terms = dict(parsed.inverted)
+        for fname, values in parsed.keyword.items():
+            field_terms.setdefault(fname, [(v, [0]) for v in values])
+        for fname, entries in field_terms.items():
+            if want and fname not in want:
+                continue
+            merged: Dict[str, list] = {}
+            for term, positions in entries:
+                merged.setdefault(term, []).extend(positions)
+            terms_out = {}
+            for t, positions in sorted(merged.items()):
+                entry: Dict[str, Any] = {"term_freq": len(positions)}
+                entry["tokens"] = [{"position": int(p)}
+                                   for p in sorted(positions)]
+                if body.get("term_statistics"):
+                    df = ttf = 0
+                    for v in searcher.views:
+                        d, f = v.segment.term_stats(fname, t)
+                        df += d
+                        ttf += f
+                    entry["doc_freq"] = df
+                    entry["ttf"] = ttf
+                terms_out[t] = entry
+            if terms_out:
+                stats = {}
+                for v in searcher.views:
+                    fp = v.segment.postings.get(fname)
+                    if fp is None:
+                        continue
+                    stats["sum_doc_freq"] = stats.get("sum_doc_freq", 0) + \
+                        int(fp.doc_freq.sum())
+                    stats["sum_ttf"] = stats.get("sum_ttf", 0) + \
+                        int(fp.total_term_freq.sum())
+                    stats["doc_count"] = stats.get("doc_count", 0) + \
+                        int((fp.doc_len > 0).sum())
+                tv[fname] = {
+                    "field_statistics": {
+                        "sum_doc_freq": stats.get("sum_doc_freq", 0),
+                        "doc_count": stats.get("doc_count", 0),
+                        "sum_ttf": stats.get("sum_ttf", 0),
+                    },
+                    "terms": terms_out,
+                }
+        return _ok({"_index": name, "_id": doc_id, "found": True,
+                    "term_vectors": tv})
+
+    def render_template(self, req: RestRequest) -> RestResponse:
+        body = dict(req.body or {})
+        rendered = _render_search_template(
+            body.get("source"), body.get("params") or {})
+        return _ok({"template_output": rendered})
+
+    def search_template(self, req: RestRequest) -> RestResponse:
+        """ref: RestSearchTemplateAction (mustache module) — render the
+        source template with params, then execute as a normal search."""
+        body = dict(req.body or {})
+        rendered = _render_search_template(
+            body.get("source"), body.get("params") or {})
+        sub = RestRequest("POST", "", dict(req.params), rendered)
+        return self.search(sub)
 
     # ---------- index templates / cluster settings ----------
 
@@ -1388,6 +1506,35 @@ class _Handlers:
     def cat_count(self, req: RestRequest) -> RestResponse:
         total = sum(self.node.indices.get(n).doc_count() for n in self.node.indices.names())
         return RestResponse(body=f"{int(time.time())} {time.strftime('%H:%M:%S')} {total}\n",
+                            content_type="text/plain")
+
+    def cat_segments(self, req: RestRequest) -> RestResponse:
+        lines = []
+        for name in self._resolve(req.param("index", "_all")):
+            svc = self.node.indices.get(name)
+            for sid, engine in enumerate(svc.shards):
+                se = engine.acquire_searcher()
+                for v in se.views:
+                    lines.append(
+                        f"{name} {sid} _{v.segment.seg_id} "
+                        f"{int(v.live.sum())} "
+                        f"{v.segment.n_docs - int(v.live.sum())} "
+                        f"{v.segment.ram_bytes()}")
+        return RestResponse(status=200, body="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    def cat_aliases(self, req: RestRequest) -> RestResponse:
+        lines = []
+        for name, meta in self.node.cluster_state.indices.items():
+            for alias in meta.aliases:
+                lines.append(f"{alias} {name} - - - -")
+        return RestResponse(status=200, body="\n".join(lines) + "\n",
+                            content_type="text/plain")
+
+    def cat_templates(self, req: RestRequest) -> RestResponse:
+        lines = [f"{n} [{','.join(t['index_patterns'])}] {t['priority']}"
+                 for n, t in self.node.indices.templates.items()]
+        return RestResponse(status=200, body="\n".join(lines) + "\n",
                             content_type="text/plain")
 
     def cat_nodes(self, req: RestRequest) -> RestResponse:

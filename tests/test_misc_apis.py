@@ -206,3 +206,50 @@ def test_template_bare_topology_keys(env):
     st, _ = call("PUT", "/_index_template/badprio", {
         "index_patterns": ["x*"], "priority": "high"})
     assert st == 400
+
+
+def test_termvectors(env):
+    node, call = env
+    call("PUT", "/tv", {"mappings": {"properties": {"body": {"type": "text"}}}})
+    call("PUT", "/tv/_doc/1", {"body": "quick brown quick fox"})
+    call("POST", "/tv/_refresh")
+    st, r = call("POST", "/tv/_termvectors/1", {"term_statistics": True})
+    assert st == 200 and r["found"]
+    terms = r["term_vectors"]["body"]["terms"]
+    assert terms["quick"]["term_freq"] == 2
+    assert [t["position"] for t in terms["quick"]["tokens"]] == [0, 2]
+    assert terms["fox"]["doc_freq"] == 1
+    st, _ = call("POST", "/tv/_termvectors/zzz", {})
+    assert st == 404
+
+
+def test_search_template(env):
+    node, call = env
+    fill(call, n=20)
+    st, r = call("POST", "/_render/template", {
+        "source": {"query": {"match": {"body": "{{word}}"}},
+                   "size": "{{#toJson}}sz{{/toJson}}"},
+        "params": {"word": "common", "sz": 3}})
+    assert st == 200
+    assert r["template_output"]["query"]["match"]["body"] == "common"
+    assert r["template_output"]["size"] == 3
+    st, r = call("POST", "/t/_search/template", {
+        "source": {"query": {"match": {"body": "{{word}}"}}, "size": 5},
+        "params": {"word": "common"}})
+    assert st == 200 and len(r["hits"]["hits"]) == 5
+
+
+def test_termvectors_realtime_and_escaping(env):
+    node, call = env
+    call("PUT", "/rt", {"mappings": {"properties": {"b": {"type": "text"}}}})
+    call("PUT", "/rt/_doc/1", {"b": "fresh fresh words"})
+    # NO refresh: termvectors must still see the doc (realtime)
+    st, r = call("POST", "/rt/_termvectors/1", {})
+    assert st == 200 and r["term_vectors"]["b"]["terms"]["fresh"]["term_freq"] == 2
+    # template var with a quote must render safely
+    fill(call, n=3)
+    st, r = call("POST", "/_render/template", {
+        "source": {"query": {"match": {"body": "{{w}}"}}},
+        "params": {"w": 'O"Brien'}})
+    assert st == 200
+    assert r["template_output"]["query"]["match"]["body"] == 'O"Brien'
