@@ -42,6 +42,7 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/{index}/_mapping", h.get_mapping)
     r("PUT", "/{index}/_mapping", h.put_mapping)
     r("GET", "/{index}/_settings", h.get_settings)
+    r("PUT", "/{index}/_settings", h.put_settings)
     r("POST", "/{index}/_refresh", h.refresh)
     r("GET", "/{index}/_refresh", h.refresh)
     r("POST", "/_refresh", h.refresh_all)
@@ -249,6 +250,75 @@ class _Handlers:
     def put_mapping(self, req: RestRequest) -> RestResponse:
         for name in self._resolve(req.param("index"), require=True):
             self.node.indices.get(name).mapper.merge(req.body or {})
+        return _ok({"acknowledged": True})
+
+    def put_settings(self, req: RestRequest) -> RestResponse:
+        """ref: RestUpdateSettingsAction — DYNAMIC index settings update,
+        validated, committed through the cluster state (version bump) so
+        readers, replication and persistence all see it; replica-count
+        changes rebuild the index's replica routing entries."""
+        import dataclasses as _dc
+        import uuid as _uuid
+
+        from elasticsearch_tpu.cluster.state import ShardRouting
+        from elasticsearch_tpu.common.settings import Settings as _S
+        from elasticsearch_tpu.tasks.task_manager import parse_timeout_ms
+
+        body = dict(req.body or {})
+        updates = _S(body.get("settings", body))
+        flat = {}
+        for k in updates:
+            key = k if k.startswith("index.") else f"index.{k}"
+            raw = updates.raw(k)
+            if key == "index.number_of_replicas":
+                try:
+                    if int(raw) < 0:
+                        raise ValueError
+                except (TypeError, ValueError):
+                    raise IllegalArgumentError(
+                        f"Failed to parse value [{raw}] for setting [{key}]")
+            elif key == "index.default_pipeline":
+                if not isinstance(raw, str):
+                    raise IllegalArgumentError(
+                        f"[{key}] must be a pipeline name")
+            elif key.startswith("index.search.slowlog."):
+                try:
+                    parse_timeout_ms(raw)
+                except (TypeError, ValueError):
+                    raise IllegalArgumentError(
+                        f"Failed to parse value [{raw}] for setting [{key}]")
+            else:
+                raise IllegalArgumentError(
+                    f"Can't update non dynamic setting [{key}]")
+            flat[key] = raw
+
+        for name in self._resolve(req.param("index"), require=True):
+            svc = self.node.indices.get(name)
+            new_meta = _dc.replace(
+                svc.meta, settings=svc.meta.settings.with_updates(flat))
+            svc.meta = new_meta
+
+            def updater(state, name=name, new_meta=new_meta):
+                routing = list(state.routing.get(name, []))
+                if "index.number_of_replicas" in flat:
+                    want = int(flat["index.number_of_replicas"])
+                    primaries = [r for r in routing if r.primary]
+                    replicas = {r.shard_id: [x for x in routing
+                                             if not x.primary
+                                             and x.shard_id == r.shard_id]
+                                for r in primaries}
+                    routing = list(primaries)
+                    for p in primaries:
+                        have = replicas.get(p.shard_id, [])
+                        routing.extend(have[:want])
+                        for _ in range(want - len(have)):
+                            routing.append(ShardRouting(
+                                index=name, shard_id=p.shard_id,
+                                node_id=None, primary=False,
+                                state="UNASSIGNED"))
+                return state.with_index(new_meta, routing)
+
+            self.node.update_state(updater)
         return _ok({"acknowledged": True})
 
     def get_settings(self, req: RestRequest) -> RestResponse:

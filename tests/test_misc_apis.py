@@ -253,3 +253,33 @@ def test_termvectors_realtime_and_escaping(env):
         "params": {"w": 'O"Brien'}})
     assert st == 200
     assert r["template_output"]["query"]["match"]["body"] == 'O"Brien'
+
+
+def test_put_index_settings_dynamic_only(env):
+    node, call = env
+    call("PUT", "/ps", {})
+    st, _ = call("PUT", "/ps/_settings", {
+        "index": {"default_pipeline": "clean-later",
+                  "search": {"slowlog": {"threshold": {"query": {
+                      "warn": "500ms"}}}}}})
+    assert st == 200
+    svc = node.indices.get("ps")
+    assert svc.meta.settings.raw("index.default_pipeline") == "clean-later"
+    assert svc.meta.settings.raw(
+        "index.search.slowlog.threshold.query.warn") == "500ms"
+    # committed THROUGH cluster state (replication/persistence path)
+    cs_meta = node.cluster_state.indices["ps"]
+    assert cs_meta.settings.raw("index.default_pipeline") == "clean-later"
+    st, _ = call("PUT", "/ps/_settings", {"index": {"number_of_shards": 4}})
+    assert st == 400
+    st, _ = call("PUT", "/ps/_settings",
+                 {"index": {"number_of_replicas": "three"}})
+    assert st == 400
+    # replica growth materializes routing entries
+    st, _ = call("PUT", "/ps/_settings", {"index": {"number_of_replicas": 2}})
+    assert st == 200
+    routing = node.cluster_state.routing["ps"]
+    assert sum(1 for r in routing if not r.primary) == 2
+    st, _ = call("PUT", "/ps/_settings", {"index": {"number_of_replicas": 0}})
+    routing = node.cluster_state.routing["ps"]
+    assert sum(1 for r in routing if not r.primary) == 0
