@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional
@@ -34,6 +33,7 @@ import numpy as np
 
 from elasticsearch_tpu.common.errors import DocumentMissingError, VersionConflictError
 from elasticsearch_tpu.index.segment import Segment, SegmentBuilder
+from elasticsearch_tpu.index.segment_io import segment_from_blob, segment_to_blob
 from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
 from elasticsearch_tpu.index.translog import Translog
 from elasticsearch_tpu.mapper.mapper_service import MapperService
@@ -432,8 +432,9 @@ class InternalEngine:
         """Commit: persist segments + metadata, roll translog generation.
 
         Ref: InternalEngine.flush — Lucene commit + translog rollover. Segment
-        payloads are pickled host arrays (the segment IS the checkpoint;
-        SURVEY.md §5.4)."""
+        payloads are data-only array blobs (the segment IS the checkpoint;
+        SURVEY.md §5.4; segment_io replaces pickle so on-disk state is never
+        executable on load — ADVICE r3)."""
         if self.data_path is None:
             return
         with self._lock:
@@ -442,11 +443,11 @@ class InternalEngine:
             os.makedirs(seg_dir, exist_ok=True)
             names = []
             for i, seg in enumerate(self._segments):
-                name = f"seg-{seg.seg_id}.pkl"
+                name = f"seg-{seg.seg_id}.seg"
                 path = os.path.join(seg_dir, name)
                 if not os.path.exists(path):
                     with open(path + ".tmp", "wb") as f:
-                        pickle.dump(seg, f, protocol=pickle.HIGHEST_PROTOCOL)
+                        f.write(segment_to_blob(seg))
                     os.replace(path + ".tmp", path)
                 names.append({"file": name, "live": self._live[i].tolist()})
             gen = self.translog.rollover()
@@ -482,7 +483,7 @@ class InternalEngine:
             seg_dir = os.path.join(self.data_path, "segments")
             for meta in commit["segments"]:
                 with open(os.path.join(seg_dir, meta["file"]), "rb") as f:
-                    seg: Segment = pickle.load(f)
+                    seg: Segment = segment_from_blob(f.read())
                 seg_idx = len(self._segments)
                 live = np.asarray(meta["live"], bool)
                 self._segments.append(seg)
@@ -510,7 +511,7 @@ class InternalEngine:
         published segment with its live mask (ref:
         indices/recovery/RecoverySourceHandler.java:267 phase1 — segment
         files are the recovery snapshot; here the segment IS the file).
-        Returns ([(pickled segment bytes, live mask)], max_seq_no)."""
+        Returns ([(segment blob bytes, live mask)], max_seq_no)."""
         with self._lock:
             self.refresh()
             # segments are immutable once published: snapshot the references
@@ -519,10 +520,7 @@ class InternalEngine:
             snapshot = [(seg, self._live[i].copy())
                         for i, seg in enumerate(self._segments)]
             max_seq_no = self._seqno.max_seq_no
-        payloads = [
-            (pickle.dumps(seg, protocol=pickle.HIGHEST_PROTOCOL), live)
-            for seg, live in snapshot
-        ]
+        payloads = [(segment_to_blob(seg), live) for seg, live in snapshot]
         return payloads, max_seq_no
 
     def install_segment(self, blob: bytes, live_mask) -> None:
@@ -530,7 +528,7 @@ class InternalEngine:
         (ref: indices/recovery/MultiFileWriter.java writes phase1 files).
         Ops-phase replay above the snapshot's seqnos follows separately."""
         with self._lock:
-            seg: Segment = pickle.loads(blob)
+            seg: Segment = segment_from_blob(blob)
             seg_idx = len(self._segments)
             live = np.asarray(live_mask, bool)
             # remap to a locally-assigned seg id: the source's id can collide

@@ -22,7 +22,6 @@ for peer recovery) around the TPU engine:
 
 from __future__ import annotations
 
-import pickle
 import threading
 import uuid
 from dataclasses import dataclass

@@ -1,0 +1,197 @@
+"""Data-only segment serialization: JSON header + raw numpy arrays.
+
+Replaces pickle for every path where segment bytes cross a trust boundary —
+snapshot repositories (an arbitrary, shareable directory; ref:
+repositories/blobstore/BlobStoreRepository.java stores data-only formats),
+peer-recovery file transfers, and on-disk commits. Deserialization never
+executes code: arrays load with ``allow_pickle=False`` and everything else
+is JSON.
+
+Blob layout::
+
+    b"ESTPUSEG2" | u64 header_len | header JSON (utf-8) | npz payload
+
+The header carries structure (which fields exist, term dictionaries,
+doc ids, sources); the npz payload carries every numpy array keyed by a
+flat path (nested child segments recurse with a ``nested.<name>/`` key
+prefix).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import Dict
+
+import numpy as np
+
+MAGIC = b"ESTPUSEG2"
+
+
+def _put_field_postings(fp, prefix: str, arrays: Dict[str, np.ndarray],
+                        meta: dict) -> None:
+    meta["terms"] = fp.terms
+    meta["sum_doc_len"] = float(fp.sum_doc_len)
+    for name in ("doc_freq", "total_term_freq", "block_start", "block_count",
+                 "block_docs", "block_tfs", "block_max_tf", "post_start",
+                 "post_doc", "pos_start", "pos_data", "doc_len"):
+        arrays[prefix + name] = getattr(fp, name)
+
+
+def _get_field_postings(field: str, prefix: str, arrays, meta: dict):
+    from elasticsearch_tpu.index.segment import FieldPostings
+
+    terms = list(meta["terms"])
+    kw = {name: np.asarray(arrays[prefix + name])
+          for name in ("doc_freq", "total_term_freq", "block_start",
+                       "block_count", "block_docs", "block_tfs",
+                       "block_max_tf", "post_start", "post_doc", "pos_start",
+                       "pos_data", "doc_len")}
+    return FieldPostings(field=field, term_to_ord={t: i for i, t in enumerate(terms)},
+                         terms=terms, sum_doc_len=float(meta["sum_doc_len"]), **kw)
+
+
+def _flatten_segment(seg, prefix: str, arrays: Dict[str, np.ndarray]) -> dict:
+    meta: dict = {
+        "seg_id": int(seg.seg_id),
+        "doc_ids": list(seg.doc_ids),
+        "sources": list(seg.sources),
+        "postings": {},
+        "numeric": sorted(seg.numeric),
+        "keyword": {},
+        "vectors": {},
+        "geo": sorted(seg.geo),
+        "nested": {},
+    }
+    arrays[prefix + "seq_nos"] = seg.seq_nos
+    arrays[prefix + "versions"] = seg.versions
+    for field, fp in seg.postings.items():
+        fmeta: dict = {}
+        _put_field_postings(fp, f"{prefix}post.{field}/", arrays, fmeta)
+        meta["postings"][field] = fmeta
+    for field, nc in seg.numeric.items():
+        p = f"{prefix}num.{field}/"
+        arrays[p + "values"] = nc.values
+        arrays[p + "max_values"] = nc.max_values
+        arrays[p + "exists"] = nc.exists
+        arrays[p + "value_start"] = nc.value_start
+        arrays[p + "all_values"] = nc.all_values
+    for field, kc in seg.keyword.items():
+        p = f"{prefix}kw.{field}/"
+        meta["keyword"][field] = {"terms": kc.terms}
+        arrays[p + "ords"] = kc.ords
+        arrays[p + "max_ords"] = kc.max_ords
+        arrays[p + "exists"] = kc.exists
+        arrays[p + "ord_start"] = kc.ord_start
+        arrays[p + "all_ords"] = kc.all_ords
+    for field, vc in seg.vectors.items():
+        p = f"{prefix}vec.{field}/"
+        meta["vectors"][field] = {"dims": int(vc.dims),
+                                  "similarity": vc.similarity}
+        arrays[p + "vectors"] = vc.vectors
+        arrays[p + "norms"] = vc.norms
+        arrays[p + "exists"] = vc.exists
+    for field, gc in seg.geo.items():
+        p = f"{prefix}geo.{field}/"
+        arrays[p + "lat"] = gc.lat
+        arrays[p + "lon"] = gc.lon
+        arrays[p + "value_start"] = gc.value_start
+        arrays[p + "exists"] = gc.exists
+    for field, nt in seg.nested.items():
+        p = f"{prefix}nested.{field}/"
+        child_meta = _flatten_segment(nt.child, p + "child/", arrays)
+        arrays[p + "parent_of"] = nt.parent_of
+        arrays[p + "child_start"] = nt.child_start
+        meta["nested"][field] = child_meta
+    return meta
+
+
+def _rebuild_segment(meta: dict, prefix: str, arrays):
+    from elasticsearch_tpu.index.segment import (
+        GeoColumn, KeywordColumn, NestedTable, NumericColumn, Segment,
+        VectorColumn,
+    )
+
+    postings = {f: _get_field_postings(f, f"{prefix}post.{f}/", arrays, m)
+                for f, m in meta["postings"].items()}
+    numeric = {}
+    for f in meta["numeric"]:
+        p = f"{prefix}num.{f}/"
+        numeric[f] = NumericColumn(
+            values=np.asarray(arrays[p + "values"]),
+            max_values=np.asarray(arrays[p + "max_values"]),
+            exists=np.asarray(arrays[p + "exists"]),
+            value_start=np.asarray(arrays[p + "value_start"]),
+            all_values=np.asarray(arrays[p + "all_values"]))
+    keyword = {}
+    for f, km in meta["keyword"].items():
+        p = f"{prefix}kw.{f}/"
+        terms = list(km["terms"])
+        keyword[f] = KeywordColumn(
+            terms=terms, term_to_ord={t: i for i, t in enumerate(terms)},
+            ords=np.asarray(arrays[p + "ords"]),
+            max_ords=np.asarray(arrays[p + "max_ords"]),
+            exists=np.asarray(arrays[p + "exists"]),
+            ord_start=np.asarray(arrays[p + "ord_start"]),
+            all_ords=np.asarray(arrays[p + "all_ords"]))
+    vectors = {}
+    for f, vm in meta["vectors"].items():
+        p = f"{prefix}vec.{f}/"
+        vectors[f] = VectorColumn(
+            vectors=np.asarray(arrays[p + "vectors"]),
+            norms=np.asarray(arrays[p + "norms"]),
+            exists=np.asarray(arrays[p + "exists"]),
+            dims=int(vm["dims"]), similarity=vm["similarity"])
+    geo = {}
+    for f in meta["geo"]:
+        p = f"{prefix}geo.{f}/"
+        geo[f] = GeoColumn(
+            lat=np.asarray(arrays[p + "lat"]),
+            lon=np.asarray(arrays[p + "lon"]),
+            value_start=np.asarray(arrays[p + "value_start"]),
+            exists=np.asarray(arrays[p + "exists"]))
+    nested = {}
+    for f, child_meta in meta["nested"].items():
+        p = f"{prefix}nested.{f}/"
+        nested[f] = NestedTable(
+            child=_rebuild_segment(child_meta, p + "child/", arrays),
+            parent_of=np.asarray(arrays[p + "parent_of"]),
+            child_start=np.asarray(arrays[p + "child_start"]))
+    return Segment(
+        seg_id=int(meta["seg_id"]), doc_ids=list(meta["doc_ids"]),
+        sources=list(meta["sources"]), postings=postings, numeric=numeric,
+        keyword=keyword, vectors=vectors,
+        seq_nos=np.asarray(arrays[prefix + "seq_nos"]),
+        versions=np.asarray(arrays[prefix + "versions"]),
+        geo=geo, nested=nested)
+
+
+def segment_to_blob(seg) -> bytes:
+    """Serialize a Segment to a self-contained data-only blob."""
+    arrays: Dict[str, np.ndarray] = {}
+    meta = _flatten_segment(seg, "", arrays)
+    # field names may contain any character; npz keys are positional
+    # (`a<i>`) and the header maps real key -> position, so no escaping
+    # scheme can collide
+    names = sorted(arrays)
+    meta["__array_names__"] = names
+    header = json.dumps(meta).encode()
+    buf = io.BytesIO()
+    np.savez(buf, **{f"a{i}": arrays[name] for i, name in enumerate(names)})
+    payload = buf.getvalue()
+    return MAGIC + len(header).to_bytes(8, "big") + header + payload
+
+
+def segment_from_blob(blob: bytes):
+    """Rebuild a Segment from a blob. Never unpickles."""
+    if not blob.startswith(MAGIC):
+        raise ValueError(
+            "not a segment blob (bad magic); refusing to parse — legacy "
+            "pickled segments are unsupported (reindex from source)")
+    hlen = int.from_bytes(blob[len(MAGIC): len(MAGIC) + 8], "big")
+    off = len(MAGIC) + 8
+    meta = json.loads(blob[off: off + hlen].decode())
+    npz = np.load(io.BytesIO(blob[off + hlen:]), allow_pickle=False)
+    names = meta.pop("__array_names__")
+    arrays = {name: npz[f"a{i}"] for i, name in enumerate(names)}
+    return _rebuild_segment(meta, "", arrays)

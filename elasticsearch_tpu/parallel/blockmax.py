@@ -353,27 +353,28 @@ class BlockMaxBM25:
         t0 = _time.monotonic()
         qa_b, qa_max = PASS_A_BLOCKS, _GROUP_SHAPES[0][1]
         qa_max = min(qa_max, self._qc_dense_cap)
-        a_packed = []
-        off = 0
-        while off < len(flat):
+        a_packed = []   # (packed result, real query count) — padding may land
+        off = 0         # in ANY chunk (qa_qc = max(dp, ...) can exceed the
+        while off < len(flat):   # chunk), so slice per chunk (ADVICE r3)
             chunk = flat[off: off + qa_max]
             off += len(chunk)
+            n_real = len(chunk)
             # two sizes only (8 or the capped max): every extra (shape)
             # pair is a fresh XLA compile — keep the program cache tiny
             qa_qc = max(dp, 8 if len(chunk) <= 8 else qa_max)
             if len(chunk) < qa_qc:
                 chunk = chunk + [chunk[-1]] * (qa_qc - len(chunk))
             W, qb, qi_ = self._assemble(chunk, None, qa_b)
-            a_packed.append(_hybrid_program(
+            a_packed.append((_hybrid_program(
                 self.stacked.block_docs, self.stacked.block_scores,
                 self.stacked.live, self.hot_cols,
                 jnp.asarray(W), jnp.asarray(qb), jnp.asarray(qi_),
-                mesh=self.mesh, k=k, tiebreak=False))
+                mesh=self.mesh, k=k, tiebreak=False), n_real))
         t1 = _time.monotonic()
         timing["assemble_a"] = t1 - t0
         # one transfer: theta for every query
         thetas = np.asarray(jnp.concatenate(
-            [p[:, 0, k - 1] for p in a_packed]))[: len(flat)]
+            [p[:n, 0, k - 1] for p, n in a_packed]))[: len(flat)]
         t2 = _time.monotonic()
         timing["theta_fetch"] = t2 - t1
 

@@ -21,6 +21,7 @@ from elasticsearch_tpu.common.errors import (
     IllegalArgumentError,
     IndexNotFoundError,
     ParsingError,
+    VersionConflictError,
 )
 from elasticsearch_tpu.node import Node
 from elasticsearch_tpu.rest.controller import RestController, RestRequest, RestResponse
@@ -1033,7 +1034,8 @@ class _Handlers:
         op_type = dest_spec.get("op_type", "index")
         query = src_spec.get("query", {"match_all": {}})
         start = time.monotonic()
-        created = updated = noops = failures = 0
+        created = updated = noops = conflicts = 0
+        failures: list = []
         with self.node.tasks.task("indices:data/write/reindex",
                                   f"reindex to [{dest}]") as task:
             if not self.node.indices.has(dest):
@@ -1070,8 +1072,18 @@ class _Handlers:
                                 created += 1
                             else:
                                 updated += 1
-                        except ElasticsearchTpuError:
-                            failures += 1
+                        except VersionConflictError:
+                            conflicts += 1
+                        except ElasticsearchTpuError as e:
+                            # non-conflict errors (mapping conflicts etc.)
+                            # must surface in `failures`, not masquerade as
+                            # version_conflicts (ref: reindex module's
+                            # BulkByScrollResponse; ADVICE r3)
+                            failures.append({
+                                "index": d_index, "id": doc_id,
+                                "cause": {"type": e.error_type,
+                                          "reason": str(e)},
+                                "status": e.status})
                     cursor = resp.get("_cursor")
                     if cursor is None:
                         break
@@ -1081,8 +1093,8 @@ class _Handlers:
         return _ok({"took": int((time.monotonic() - start) * 1000),
                     "timed_out": False, "total": created + updated + noops,
                     "created": created, "updated": updated, "noops": noops,
-                    "failures": [], "batches": 1,
-                    "version_conflicts": failures})
+                    "failures": failures, "batches": 1,
+                    "version_conflicts": conflicts})
 
     def field_caps(self, req: RestRequest) -> RestResponse:
         """ref: RestFieldCapabilitiesAction — per-field type/searchable/

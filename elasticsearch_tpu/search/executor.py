@@ -170,12 +170,13 @@ class LeafContext:
     def live_dev(self):
         key = f"live:{self.view.live_epoch}"
         cache = self.segment._device
-        if key not in cache:
-            # drop stale epochs for this segment
-            for k in [k for k in cache if k.startswith("live:")]:
-                del cache[k]
-            cache[key] = jnp.asarray(self.view.live)
-        return cache[key]
+        with self.segment._device_lock:
+            if key not in cache:
+                # drop stale epochs for this segment
+                for k in [k for k in cache if k.startswith("live:")]:
+                    del cache[k]
+                cache[key] = jnp.asarray(self.view.live)
+            return cache[key]
 
 
 def leaves(searcher: EngineSearcher) -> List[LeafContext]:
@@ -723,13 +724,14 @@ class QueryExecutor:
 
         nt = leaf.segment.nested[path]
         cache_key = f"nestedleaf:{path}"
-        ctx = leaf.segment._device.get(cache_key)
-        if ctx is None:
-            view = SegmentView(segment=nt.child,
-                               live=np.ones(nt.child.n_docs, bool),
-                               live_epoch=0)
-            ctx = (LeafContext(view, base=0), ShardStats([view]))
-            leaf.segment._device[cache_key] = ctx
+        with leaf.segment._device_lock:
+            ctx = leaf.segment._device.get(cache_key)
+            if ctx is None:
+                view = SegmentView(segment=nt.child,
+                                   live=np.ones(nt.child.n_docs, bool),
+                                   live_epoch=0)
+                ctx = (LeafContext(view, base=0), ShardStats([view]))
+                leaf.segment._device[cache_key] = ctx
         child_leaf, child_stats = ctx
         child_ex = QueryExecutor(self.mapper, child_stats)
         child_ex.check = self.check
@@ -746,15 +748,19 @@ class QueryExecutor:
         is the query's canonical repr; storage rides the segment's device-
         array cache and dies with the segment."""
         cache = leaf.segment._device
+        # key: auto-generated dataclass repr — field-complete for every
+        # cacheable (flat, scalar-field) query type routed here
         key = f"qcache:{query!r}"
-        hit = cache.get(key)
+        with leaf.segment._device_lock:
+            hit = cache.get(key)
         if hit is not None:
             return hit
         mask = builder()
-        keys = [k for k in cache if k.startswith("qcache:")]
-        if len(keys) >= self._QUERY_CACHE_MAX:
-            cache.pop(keys[0], None)
-        cache[key] = mask
+        with leaf.segment._device_lock:
+            keys = [k for k in cache if k.startswith("qcache:")]
+            if len(keys) >= self._QUERY_CACHE_MAX:
+                cache.pop(keys[0], None)
+            cache[key] = mask
         return mask
 
     def _none(self, leaf):

@@ -17,7 +17,10 @@ Layout under the repository root:
                                           older snapshots' metadata)
     indices/{index}/{shard}/manifest-{name}.json
         — ordered [(blob hash, live mask RLE, n_docs)], max_seq_no
-    blobs/{sha256}.seg                  — pickled segment payloads (shared)
+    blobs/{sha256}.seg                  — data-only segment blobs (shared;
+                                          segment_io format, never pickle —
+                                          a repository is an untrusted
+                                          shareable directory)
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -62,6 +66,10 @@ class FsRepository:
         self.name = name
         self.location = location
         self.readonly = readonly
+        # serializes create/delete/GC so a concurrent delete can never GC a
+        # blob belonging to an in-flight snapshot (the reference serializes
+        # snapshot operations through cluster state; ADVICE r3)
+        self.mutation_lock = threading.Lock()
         os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
         if not os.path.exists(self._path("index.json")):
             self._write_json("index.json", {"snapshots": []})
@@ -119,10 +127,15 @@ class FsRepository:
     def read_segment_blob(self, h: str) -> bytes:
         try:
             with open(self._path("blobs", f"{h}.seg"), "rb") as f:
-                return f.read()
+                data = f.read()
         except FileNotFoundError:
             raise RepositoryError(f"segment blob [{h}] missing from "
                                   f"repository [{self.name}]")
+        if hashlib.sha256(data).hexdigest() != h:
+            raise RepositoryError(
+                f"segment blob [{h}] failed checksum verification in "
+                f"repository [{self.name}] (corrupted or tampered)")
+        return data
 
     # ---- write a snapshot ----
 
@@ -162,6 +175,10 @@ class FsRepository:
     # ---- delete + GC ----
 
     def delete_snapshot(self, name: str) -> None:
+        with self.mutation_lock:
+            self._delete_snapshot_locked(name)
+
+    def _delete_snapshot_locked(self, name: str) -> None:
         meta = self.snapshot_meta(name)
         idx = self._read_json("index.json") or {"snapshots": []}
         idx["snapshots"] = [s for s in idx["snapshots"] if s != name]

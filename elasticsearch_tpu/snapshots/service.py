@@ -61,10 +61,18 @@ class SnapshotsService:
         if not snap_name or snap_name != snap_name.lower() or "/" in snap_name:
             raise InvalidSnapshotNameError(
                 f"[{snap_name}] must be lowercase and without '/'")
-        if snap_name in repo.snapshots():
-            raise InvalidSnapshotNameError(
-                f"[{repo_name}:{snap_name}] snapshot already exists")
         names = indices or self.indices.names()
+        # hold the repository mutation lock across the exists-check + blob +
+        # manifest writes so a concurrent delete's GC cannot reap blobs of
+        # this in-flight snapshot and two same-name creates cannot both pass
+        # the exists check (ADVICE r3)
+        with repo.mutation_lock:
+            if snap_name in repo.snapshots():
+                raise InvalidSnapshotNameError(
+                    f"[{repo_name}:{snap_name}] snapshot already exists")
+            return self._create_locked(repo, snap_name, names)
+
+    def _create_locked(self, repo, snap_name, names) -> dict:
         start_ms = int(time.time() * 1000)
         out_indices: Dict[str, dict] = {}
         total_segments = 0
@@ -125,10 +133,19 @@ class SnapshotsService:
                 indices: Optional[List[str]] = None,
                 rename_pattern: Optional[str] = None,
                 rename_replacement: Optional[str] = None) -> dict:
+        repo = self.repository(repo_name)
+        # restore reads manifests + blobs: hold the mutation lock so a
+        # concurrent delete cannot GC them mid-restore
+        with repo.mutation_lock:
+            meta = repo.snapshot_meta(snap_name)
+            return self._restore_locked(
+                repo, snap_name, meta, indices, rename_pattern,
+                rename_replacement)
+
+    def _restore_locked(self, repo, snap_name, meta, indices,
+                        rename_pattern, rename_replacement) -> dict:
         import re
 
-        repo = self.repository(repo_name)
-        meta = repo.snapshot_meta(snap_name)
         targets = indices or meta["indices"]
         restored = []
         for index in targets:
