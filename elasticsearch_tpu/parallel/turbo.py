@@ -1,0 +1,469 @@
+"""TurboBM25: the flagship TPU serving engine (int8 column cache + Pallas).
+
+The architecture follows the measured realities of the target TPU (see
+kernels.py): everything the chip is fast at (big int8 MXU matmuls, tiled
+VPU ops) happens on device; everything it is slow at (scatter, sort,
+gather) happens either at column-build time via the outer-product trick or
+on the host over provably tiny data.
+
+Per query the terms split three ways:
+
+* **colized** (df >= COLD_DF): the term owns a dense int8 impact column in
+  the device cache (LRU over HBM budget, built on device by
+  kernels.build_columns — no multi-GB host->device transfer). Scoring is
+  one exact-integer matmul sweep producing per-superwindow top-NCAND
+  candidates.
+* **cold** (df < COLD_DF): at most a few thousand postings. The host
+  computes EXACT totals for every cold-touched doc — it looks up the
+  other query terms' impacts by binary search in the posting arrays — so
+  any doc with a cold contribution is scored exactly with no device help.
+* the final top-k merges both sides: the host rescores the device's top
+  candidates in exact f32 (term-order identical to the reference scorer)
+  and checks a per-query CERTIFICATE that bounds what quantization could
+  hide:
+
+      exact_kth >= max(approx_21st, max_sw sw_NCANDth) + e_q
+
+  where e_q is the int8 quantization error bound. Docs with cold lanes
+  are exact by construction; colized-only docs outside the candidate set
+  provably cannot beat the k-th result. If the certificate fails (rare),
+  the query falls back to the caller-provided exact path.
+
+Ref: this replaces the reference's per-segment BulkScorer loop
+(ContextIndexSearcher.java:213-216) and its BlockMaxWAND pruning — the TPU
+answer to dynamic pruning is candidate generation at memory bandwidth plus
+host verification, not branchy skipping.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from elasticsearch_tpu.ops import bm25_idf
+from elasticsearch_tpu.parallel.blockmax import _host_block_scores
+from elasticsearch_tpu.parallel.kernels import (
+    CAND_PAD, COLSCALE, COLSCALE2, MAX_GROUP_ROWS, NCAND, SW, TILE,
+    build_columns, score_columns,
+)
+from elasticsearch_tpu.parallel.spmd import StackedBM25
+
+COLD_DF = 16384        # below this, terms are host-scored
+RESCORE = 20           # device candidates exactly rescored per query
+K1_PLUS1 = 2.2         # BM25 idf-free impact upper bound
+_BUILD_BUCKETS = (256, 1024, 4096, 16384, 32768)   # last one bounded by
+#   SMEM: 4 prefetch arrays x bucket x 4B must stay well under the 1MB SMEM
+
+
+def _bucket(n: int) -> int:
+    for b in _BUILD_BUCKETS:
+        if n <= b:
+            return b
+    return _BUILD_BUCKETS[-1]
+
+
+@dataclass
+class _TermInfo:
+    ord: int
+    df: int
+    idf: float
+    row_start: int          # first block row
+    n_rows: int             # block rows
+    smax: float             # max idf-free lane score
+
+
+class TurboBM25:
+    """Single-partition serving engine over a StackedBM25 (S == 1).
+
+    qc_sizes: compiled dispatch widths (queries per kernel launch).
+    hbm_budget_bytes: HBM reserved for the int8 column cache.
+    fallback: callable(terms: [(term, boost)], k) -> (scores, ords) exact
+        results, used when a certificate fails.
+    """
+
+    def __init__(self, stacked: StackedBM25, *,
+                 hbm_budget_bytes: int = 10 << 30,
+                 qc_sizes: Tuple[int, ...] = (8, 20),
+                 fallback: Optional[Callable] = None):
+        assert stacked.n_shards == 1, "TurboBM25 v1 serves one partition"
+        self.stacked = stacked
+        self.fp = stacked.postings[0]
+        self.fallback = fallback
+        self.D = stacked.doc_counts[0]
+        self.Dp = -(-self.D // SW) * SW
+        self.nsw = self.Dp // SW
+        self.dp_rows = self.Dp // 128
+        self.qc_sizes = tuple(sorted(qc_sizes))
+
+        fp = self.fp
+        # lane arrays with trailing DMA padding rows
+        pad = np.zeros((MAX_GROUP_ROWS, 128), np.int32)
+        self.lane_docs = jnp.asarray(
+            np.concatenate([fp.block_docs, pad], axis=0))
+        bs = _host_block_scores(fp, stacked.avgdl)
+        self.lane_scores = jnp.asarray(
+            np.concatenate([bs, pad.astype(np.float32)], axis=0))
+        self._host_scores = bs       # [T, 128] idf-free lane scores
+        # per-block doc ranges for group building (pad lanes are 0 so the
+        # row max is the true last doc; row 0 is the reserved zero block)
+        self._blo = fp.block_docs[:, 0].astype(np.int64)
+        self._bhi = fp.block_docs.max(axis=1).astype(np.int64)
+
+        # live mask as f32 rows
+        lh = stacked.live_host[0] if stacked.live_host is not None else None
+        lv = np.zeros(self.Dp, np.float32)
+        if lh is None:
+            lv[: self.D] = 1.0
+        else:
+            lv[: self.D] = lh[: self.D].astype(np.float32)
+        self.live = jnp.asarray(lv.reshape(self.dp_rows, 128))
+        self._live_host = lv
+
+        # column cache sizing: slots + 1 scratch slot for padding groups
+        # (2 bytes per doc per slot: hi + lo residual layers)
+        slots = max(int(hbm_budget_bytes // (2 * self.Dp)), 32)
+        n_colizable = int((fp.doc_freq >= COLD_DF).sum())
+        slots = min(slots, max(n_colizable, 1) + 8)
+        self.Hp = ((slots + 31) // 32) * 32
+        dp_chunks = self.dp_rows // 16
+        self.cols_hi = jnp.zeros((dp_chunks, self.Hp + 1, 16, 128), jnp.int8)
+        self.cols_lo = jnp.zeros((dp_chunks, self.Hp + 1, 16, 128), jnp.int8)
+        self._slot_of: Dict[str, int] = {}
+        self._lru: Dict[str, int] = {}
+        self._free = list(range(self.Hp))
+        self._pending_zero: List[tuple] = []
+        self._tick = 0
+        self._terms: Dict[str, Optional[_TermInfo]] = {}
+        self.stats = {"builds": 0, "build_s": 0.0, "fallbacks": 0,
+                      "cold_queries": 0, "dispatches": 0}
+
+    # ---------------- term metadata ----------------
+
+    def _term(self, term: str) -> Optional[_TermInfo]:
+        if term in self._terms:
+            return self._terms[term]
+        fp = self.fp
+        o = fp.ord(term)
+        if o < 0:
+            self._terms[term] = None
+            return None
+        df = int(fp.doc_freq[o])
+        start, cnt = int(fp.block_start[o]), int(fp.block_count[o])
+        smax = float(self._host_scores[start: start + cnt].max()) if cnt else 0.0
+        info = _TermInfo(ord=o, df=df,
+                         idf=bm25_idf(self.stacked.total_docs, df),
+                         row_start=start, n_rows=cnt, smax=smax)
+        self._terms[term] = info
+        return info
+
+    # ---------------- column cache ----------------
+
+    def _term_groups(self, info: _TermInfo, slot: int):
+        """(rows, nrows, bases, slots) arrays for one term's build groups —
+        one group per touched 16384-doc tile."""
+        lo = self._blo[info.row_start: info.row_start + info.n_rows]
+        hi = self._bhi[info.row_start: info.row_start + info.n_rows]
+        t0, t1 = int(lo[0]) // TILE, int(hi[-1]) // TILE
+        tiles = np.arange(t0, t1 + 1, dtype=np.int64)
+        starts = np.searchsorted(hi, tiles * TILE, side="left")
+        ends = np.searchsorted(lo, (tiles + 1) * TILE, side="left")
+        n = (ends - starts).astype(np.int32)
+        keep = n > 0
+        return (info.row_start + starts[keep].astype(np.int32),
+                n[keep],
+                (tiles[keep] * TILE).astype(np.int32),
+                np.full(int(keep.sum()), slot, np.int32))
+
+    def ensure_columns(self, terms: Sequence[str]) -> None:
+        self._tick += 1
+        need: List[_TermInfo] = []
+        for t in dict.fromkeys(terms):
+            info = self._term(t)
+            if info is None or info.df < COLD_DF:
+                continue
+            if t in self._slot_of:
+                self._lru[t] = self._tick
+                continue
+            need.append((t, info))
+        if not need:
+            return
+        protect = set(t for t, _ in need) | set(terms)
+        deficit = len(need) - len(self._free)
+        if deficit > 0:
+            victims = [t for t in sorted(self._lru, key=self._lru.get)
+                       if t not in protect][:deficit]
+            if len(victims) < deficit:
+                raise ValueError(
+                    f"batch needs {len(need)} columns > capacity {self.Hp}")
+            for v in victims:
+                slot = self._slot_of.pop(v)
+                del self._lru[v]
+                self._free.append(slot)
+                # zero the evicted term's tiles so the reused slot carries
+                # no phantom scores (only its touched tiles need clearing)
+                vinfo = self._terms.get(v)
+                if vinfo is not None:
+                    r, n, b, s = self._term_groups(vinfo, slot)
+                    self._pending_zero.append(
+                        (r, np.zeros_like(n), b, s))
+        rows_l, n_l, base_l, slot_l = [], [], [], []
+        for r, n, b, s in self._pending_zero:
+            rows_l.append(r); n_l.append(n); base_l.append(b); slot_l.append(s)
+        self._pending_zero = []
+        for t, info in need:
+            slot = self._free.pop()
+            self._slot_of[t] = slot
+            self._lru[t] = self._tick
+            r, n, b, s = self._term_groups(info, slot)
+            rows_l.append(r); n_l.append(n); base_l.append(b); slot_l.append(s)
+        rows = np.concatenate(rows_l)
+        nrows = np.concatenate(n_l)
+        bases = np.concatenate(base_l)
+        slots = np.concatenate(slot_l)
+        t0 = time.monotonic()
+        # split giant (cold-start) builds into bounded dispatches
+        for off in range(0, len(rows), _BUILD_BUCKETS[-1]):
+            part = slice(off, off + _BUILD_BUCKETS[-1])
+            r_p, n_p, b_p, s_p = rows[part], nrows[part], bases[part], slots[part]
+            ng = _bucket(len(r_p))
+            pad = ng - len(r_p)
+            self.cols_hi, self.cols_lo = build_columns(
+                jnp.asarray(np.concatenate([r_p, np.zeros(pad, np.int32)])),
+                jnp.asarray(np.concatenate([n_p, np.zeros(pad, np.int32)])),
+                jnp.asarray(np.concatenate([b_p, np.zeros(pad, np.int32)])),
+                jnp.asarray(np.concatenate(
+                    [s_p, np.full(pad, self.Hp, np.int32)])),
+                self.lane_docs, self.lane_scores,
+                self.cols_hi, self.cols_lo, n_groups=ng)
+        self.stats["builds"] += len(need)
+        self.stats["build_s"] += time.monotonic() - t0
+
+    # ---------------- host exact scoring helpers ----------------
+
+    def _impacts_at(self, info: _TermInfo, docs: np.ndarray) -> np.ndarray:
+        """Exact idf-free impact of a term at the given doc ids (0 where
+        the term does not occur)."""
+        fp = self.fp
+        lo, hi = int(fp.post_start[info.ord]), int(fp.post_start[info.ord + 1])
+        tdocs = fp.post_doc[lo:hi]
+        lanes = self._host_scores[
+            info.row_start: info.row_start + info.n_rows].ravel()[: hi - lo]
+        j = np.searchsorted(tdocs, docs)
+        j_c = np.minimum(j, len(tdocs) - 1) if len(tdocs) else j
+        present = (j < len(tdocs))
+        if len(tdocs):
+            present &= tdocs[j_c] == docs
+        out = np.zeros(len(docs), np.float32)
+        if len(tdocs):
+            out[present] = lanes[j_c[present]]
+        return out
+
+    def _exact_merge(self, qterms, k: int):
+        """Full host posting merge (exact, any df) — the fallback when a
+        certificate fails. Term-at-a-time f32 accumulation in query
+        order, (score desc, doc asc) rank over live docs."""
+        all_docs = []
+        for _, _, info in qterms:
+            fp = self.fp
+            lo, hi = (int(fp.post_start[info.ord]),
+                      int(fp.post_start[info.ord + 1]))
+            all_docs.append(fp.post_doc[lo:hi])
+        if not all_docs:
+            return np.empty(0, np.float32), np.empty(0, np.int32)
+        docs = np.unique(np.concatenate(all_docs))
+        docs = docs[self._live_host[docs] > 0]
+        totals = self._exact_scores(qterms, docs)
+        pos = totals > 0
+        docs, totals = docs[pos], totals[pos]
+        sel = np.lexsort((docs, -totals))[:k]
+        return totals[sel], docs[sel].astype(np.int32)
+
+    def _exact_scores(self, qterms: List[Tuple[str, float, _TermInfo]],
+                      docs: np.ndarray) -> np.ndarray:
+        """Exact f32 totals at docs, term-at-a-time in query order — the
+        same accumulation order as the reference CPU scorer."""
+        total = np.zeros(len(docs), np.float32)
+        for _, boost, info in qterms:
+            w = np.float32(info.idf * boost)
+            total = total + w * self._impacts_at(info, docs)
+        return total
+
+    # ---------------- search ----------------
+
+    def search_many(self, batches: Sequence[List], k: int = 10):
+        """Pipeline batches of queries; returns per batch
+        (scores [Q, k] f32, ords [Q, k] i32). Queries are term lists or
+        (term, boost) lists."""
+        flat: List[List[Tuple[str, float]]] = []
+        spans = []
+        for queries in batches:
+            spans.append((len(flat), len(queries)))
+            for q in queries:
+                agg: Dict[str, float] = {}
+                for t in q:
+                    t, b = (t, 1.0) if isinstance(t, str) else t
+                    agg[t] = agg.get(t, 0.0) + b
+                flat.append(list(agg.items()))
+        if not flat:
+            return [(np.zeros((n, k), np.float32), np.zeros((n, k), np.int32))
+                    for _, n in spans]
+        self.ensure_columns(
+            [t for q in flat for t, _ in q
+             if (i := self._term(t)) is not None and i.df >= COLD_DF])
+
+        # dispatch in QC chunks (async; fetch at the end)
+        pending = []
+        off = 0
+        while off < len(flat):
+            take = self.qc_sizes[-1]
+            if len(flat) - off <= self.qc_sizes[0]:
+                take = self.qc_sizes[0]
+            chunk = flat[off: off + take]
+            pending.append((off, len(chunk),
+                            self._dispatch(chunk, take)))
+            off += len(chunk)
+        self.stats["dispatches"] += len(pending)
+
+        out_s = np.zeros((len(flat), k), np.float32)
+        out_d = np.zeros((len(flat), k), np.int32)
+        for off, n, (cs, cd) in pending:
+            cs = np.asarray(cs)    # [nsw, QC, CAND_PAD]
+            cd = np.asarray(cd)
+            for qi in range(n):
+                s, d = self._finish_query(
+                    flat[off + qi], cs[:, qi], cd[:, qi], k)
+                out_s[off + qi, : len(s)] = s
+                out_d[off + qi, : len(d)] = d
+        return [(out_s[o: o + n], out_d[o: o + n]) for o, n in spans]
+
+    def search(self, queries: List[List], k: int = 10):
+        return self.search_many([queries], k)[0]
+
+    def _dispatch(self, chunk, QC):
+        wq = np.zeros((2, QC, self.Hp + 1), np.int8)
+        qscale = np.ones((QC, 1), np.float32)
+        for qi, terms in enumerate(chunk):
+            ws = []
+            for t, b in terms:
+                info = self._term(t)
+                if info is not None and info.df >= COLD_DF:
+                    ws.append((self._slot_of[t], info.idf * b))
+            if not ws:
+                continue
+            wmax = max(abs(w) for _, w in ws)
+            qs = max(wmax / 127.0, 1e-9)         # hi step
+            qs2 = qs / 128.0                     # lo step
+            qscale[qi, 0] = qs2 * COLSCALE2
+            for slot, w in ws:
+                wh = max(-127, min(127, round(w / qs)))
+                wl = max(-127, min(127, round((w - qs * wh) / qs2)))
+                wq[0, qi, slot] = np.int8(wh)
+                wq[1, qi, slot] = np.int8(wl)
+        return score_columns(
+            jnp.asarray(qscale), self.cols_hi, self.cols_lo,
+            jnp.asarray(wq), self.live, QC=QC, nsw=self.nsw)
+
+    def _finish_query(self, terms, cand_s, cand_d, k):
+        """Merge device candidates + host cold side into exact top-k."""
+        qterms = []
+        cold_terms = []
+        col_terms = []
+        for t, b in terms:
+            info = self._term(t)
+            if info is None:
+                continue
+            qterms.append((t, b, info))
+            (cold_terms if info.df < COLD_DF else col_terms).append(
+                (t, b, info))
+
+        if not qterms:
+            return np.empty(0, np.float32), np.empty(0, np.int32)
+
+        # quantization error bound for the device side (must mirror
+        # _dispatch's quantization exactly, including clipping)
+        e_q = 1e-7
+        ws = [(info.idf * b) for _, b, info in col_terms]
+        if ws:
+            wmax = max(abs(w) for w in ws)
+            qs = max(wmax / 127.0, 1e-9)
+            qs2 = qs / 128.0
+            for w in ws:
+                wh = max(-127, min(127, round(w / qs)))
+                wl = max(-127, min(127, round((w - qs * wh) / qs2)))
+                w_approx = qs * wh + qs2 * wl
+                e_q += (abs(w - w_approx) * K1_PLUS1
+                        + abs(w_approx) * COLSCALE2 / 2.0)
+            # f32 rounding of the in-kernel integer combine
+            e_q += 3e-7 * sum(abs(w) for w in ws) * K1_PLUS1
+        e_q = float(e_q)
+
+        # ---- cold side: exact totals for every cold-touched live doc ----
+        cold_docs = []
+        for t, b, info in cold_terms:
+            fp = self.fp
+            lo, hi = (int(fp.post_start[info.ord]),
+                      int(fp.post_start[info.ord + 1]))
+            cold_docs.append(fp.post_doc[lo:hi])
+        exact_pool: Dict[int, float] = {}
+        if cold_terms:
+            self.stats["cold_queries"] += 1
+            docs = np.unique(np.concatenate(cold_docs))
+            docs = docs[self._live_host[docs] > 0]
+            if len(docs):
+                totals = self._exact_scores(qterms, docs)
+                pos = totals > 0
+                for d, s in zip(docs[pos], totals[pos]):
+                    exact_pool[int(d)] = float(s)
+
+        # ---- device side: flatten per-sw candidates, rescore the top ----
+        sw_bound = 0.0
+        if col_terms:
+            valid = cand_s > -np.inf
+            # bound on uncollected colized-only docs: each sw's NCAND-th
+            # (smallest kept) candidate, or 0 where a sw ran dry
+            per_sw_last = np.where(
+                valid[:, NCAND - 1], cand_s[:, NCAND - 1], 0.0)
+            sw_bound = float(per_sw_last.max()) if len(per_sw_last) else 0.0
+            fs = cand_s[valid]
+            fd = cand_d[valid]
+            order = np.lexsort((fd, -fs))
+            n_rescore = max(RESCORE, k + 5)
+            top = order[: n_rescore + 1]
+            approx_next = float(fs[top[n_rescore]]) if len(top) > n_rescore \
+                else 0.0
+            rescore_d = fd[top[: n_rescore]].astype(np.int64)
+            if len(rescore_d):
+                ex = self._exact_scores(qterms, rescore_d)
+                for d, s in zip(rescore_d, ex):
+                    if s > 0 and int(d) not in exact_pool:
+                        exact_pool[int(d)] = float(s)
+        else:
+            approx_next = 0.0
+
+        if not exact_pool:
+            return np.empty(0, np.float32), np.empty(0, np.int32)
+        docs = np.fromiter(exact_pool.keys(), np.int64, len(exact_pool))
+        scores = np.fromiter(exact_pool.values(), np.float64,
+                             len(exact_pool)).astype(np.float32)
+        sel = np.lexsort((docs, -scores))[:k]
+        out_s, out_d = scores[sel], docs[sel].astype(np.int32)
+
+        # ---- certificate ----
+        if col_terms:
+            # docs outside the exact pool are bounded by the best score the
+            # device could have under-reported plus the quantization error
+            uncollected = max(sw_bound, approx_next)
+            bound = uncollected + e_q
+            kth = float(out_s[k - 1]) if len(out_s) >= k else 0.0
+            short = len(out_s) < k and uncollected > 0
+            if short or (len(out_s) >= k and kth < bound and uncollected > 0):
+                self.stats["fallbacks"] += 1
+                if self.fallback is not None:
+                    return self.fallback(terms, k)
+                return self._exact_merge(qterms, k)
+        return out_s, out_d

@@ -1,0 +1,168 @@
+"""TurboBM25 (int8 column cache + Pallas kernels) correctness tests.
+
+Runs on the CPU mesh via pallas interpret mode (tests/conftest.py forces
+JAX_PLATFORMS=cpu); differential-checked against a brute-force scorer with
+the reference accumulation order.
+"""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.segment import build_field_postings
+from elasticsearch_tpu.ops import bm25_idf
+from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+from elasticsearch_tpu.parallel.turbo import COLD_DF, TurboBM25
+
+
+class _Seg:
+    def __init__(self, n_docs, fp):
+        self.n_docs = n_docs
+        self.postings = {"body": fp}
+        self.vectors = {}
+
+
+def _corpus(n_docs=3000, vocab=300, seed=0):
+    rng = np.random.default_rng(seed)
+    probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+    probs /= probs.sum()
+    lens = rng.integers(4, 20, size=n_docs).astype(np.int64)
+    tokens = rng.choice(vocab, size=int(lens.sum()), p=probs).astype(np.int64)
+    names = [f"t{i}" for i in range(vocab)]
+    tok_docs = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+    fp = build_field_postings("body", lens, tok_docs, tokens, names)
+    return fp, probs, rng
+
+
+def _agg(q):
+    agg = {}
+    for t in q:
+        agg[t] = agg.get(t, 0.0) + 1.0
+    return list(agg.items())
+
+
+def _brute(fp, avgdl, total_docs, terms, k=10, live=None):
+    """Reference scorer: term-at-a-time f32 accumulation in query order."""
+    from elasticsearch_tpu.parallel.blockmax import _host_block_scores
+
+    bs = _host_block_scores(fp, avgdl)
+    dense = np.zeros(total_docs, np.float32)
+    for t, boost in terms:
+        o = fp.ord(t)
+        if o < 0:
+            continue
+        w = np.float32(bm25_idf(total_docs, int(fp.doc_freq[o])) * boost)
+        lo, hi = int(fp.post_start[o]), int(fp.post_start[o + 1])
+        docs = fp.post_doc[lo:hi]
+        start, cnt = int(fp.block_start[o]), int(fp.block_count[o])
+        vals = bs[start: start + cnt].ravel()[: hi - lo]
+        dense[docs] = dense[docs] + w * vals
+    if live is not None:
+        dense = np.where(live, dense, 0.0)
+    docs = np.nonzero(dense > 0)[0]
+    sel = np.lexsort((docs, -dense[docs]))[:k]
+    return dense[docs[sel]], docs[sel].astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    fp, probs, rng = _corpus()
+    stacked = build_stacked_bm25([_Seg(3000, fp)], "body", serve_only=True)
+    turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20)
+    return fp, stacked, turbo, probs, rng
+
+
+def test_cold_only_queries_exact(engine):
+    fp, stacked, turbo, probs, rng = engine
+    # all terms are cold at this corpus size (df < COLD_DF)
+    assert all(int(df) < COLD_DF for df in fp.doc_freq)
+    queries = [[f"t{a}", f"t{b}"] for a, b in
+               rng.integers(0, 200, size=(16, 2))]
+    (scores, ords), = [turbo.search(queries, k=10)]
+    for qi, q in enumerate(queries):
+        bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
+                        _agg(q), k=10)
+        n = len(bd)
+        assert np.array_equal(ords[qi][:n], bd), f"query {qi} docs"
+        np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
+
+
+def test_colized_path_exact():
+    # small dense corpus with COLD_DF forced low so columns engage
+    import elasticsearch_tpu.parallel.turbo as turbo_mod
+
+    fp, probs, rng = _corpus(n_docs=2000, vocab=50, seed=1)
+    stacked = build_stacked_bm25([_Seg(2000, fp)], "body", serve_only=True)
+    old = turbo_mod.COLD_DF
+    turbo_mod.COLD_DF = 10
+    try:
+        turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20)
+        queries = [[f"t{a}", f"t{b}"] for a, b in
+                   rng.integers(0, 50, size=(12, 2))]
+        scores, ords = turbo.search(queries, k=10)
+        for qi, q in enumerate(queries):
+            bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
+                            _agg(q), k=10)
+            n = len(bd)
+            assert np.array_equal(ords[qi][:n], bd), f"query {qi} docs"
+            np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
+        assert turbo.stats["builds"] > 0
+    finally:
+        turbo_mod.COLD_DF = old
+
+
+def test_live_mask_filters_deleted():
+    import elasticsearch_tpu.parallel.turbo as turbo_mod
+
+    fp, probs, rng = _corpus(n_docs=1500, vocab=40, seed=2)
+    live = np.ones(1500, bool)
+    live[::3] = False
+    stacked = build_stacked_bm25([_Seg(1500, fp)], "body",
+                                 live_masks=[live], serve_only=True)
+    old = turbo_mod.COLD_DF
+    turbo_mod.COLD_DF = 10
+    try:
+        turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20)
+        queries = [[f"t{a}", f"t{b}"] for a, b in
+                   rng.integers(0, 40, size=(6, 2))]
+        scores, ords = turbo.search(queries, k=10)
+        for qi, q in enumerate(queries):
+            bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
+                            _agg(q),
+                            k=10, live=live)
+            n = len(bd)
+            assert np.array_equal(ords[qi][:n], bd)
+            np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
+    finally:
+        turbo_mod.COLD_DF = old
+
+
+def test_mixed_and_boosted_queries():
+    import elasticsearch_tpu.parallel.turbo as turbo_mod
+
+    fp, probs, rng = _corpus(n_docs=2500, vocab=120, seed=3)
+    stacked = build_stacked_bm25([_Seg(2500, fp)], "body", serve_only=True)
+    old = turbo_mod.COLD_DF
+    turbo_mod.COLD_DF = 60     # head terms colized, tail cold -> mixed
+    try:
+        turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20)
+        queries = [[("t0", 2.0), (f"t{100 + i}", 1.0)] for i in range(8)]
+        scores, ords = turbo.search(queries, k=10)
+        for qi, q in enumerate(queries):
+            bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs, q, k=10)
+            n = len(bd)
+            assert np.array_equal(ords[qi][:n], bd), f"query {qi}"
+            np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
+    finally:
+        turbo_mod.COLD_DF = old
+
+
+def test_missing_terms_and_empty():
+    fp, probs, rng = _corpus(n_docs=1000, vocab=30, seed=4)
+    stacked = build_stacked_bm25([_Seg(1000, fp)], "body", serve_only=True)
+    turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20)
+    scores, ords = turbo.search([["zzz_missing"], ["t0", "zzz_missing"]],
+                                k=5)
+    assert float(scores[0].sum()) == 0.0
+    bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
+                    [("t0", 1.0)], k=5)
+    assert np.array_equal(ords[1][: len(bd)], bd)
