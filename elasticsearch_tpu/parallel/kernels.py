@@ -61,8 +61,8 @@ def _interpret() -> bool:
 # --------------------------------------------------------------------------
 
 
-def _score_kernel(QC: int, Hpt: int):
-    def kernel(qscale, hi_blk, lo_blk, wq, live_blk, out_s, out_d, acc):
+def _sweep_kernel(QC: int, Hpt: int):
+    def kernel(qscale, hi_blk, lo_blk, wq, live_blk, out_m, out_r, acc_rm):
         c = pl.program_id(1)
         sw = pl.program_id(0)
 
@@ -82,63 +82,61 @@ def _score_kernel(QC: int, Hpt: int):
         val = (16384.0 * m_hh.astype(jnp.float32)
                + 128.0 * (m_hl + m_lh).astype(jnp.float32)
                + m_ll.astype(jnp.float32))                # [QC, 16, 128]
-        acc[:, pl.ds(c * CHUNK_ROWS, CHUNK_ROWS), :] = (
-            val * qscale[...][:, :, None])
+        val = val * qscale[...][:, :, None]
+        lv = live_blk[...]                                # [16, 128] f32
+        val = jnp.where((lv[None] > 0) & (val > 0), val, -jnp.inf)
+        # transposed accumulator [chunk, 16, QC]: dim 0 is untiled, so the
+        # dynamic per-chunk store needs no 128-alignment proof
+        acc_rm[pl.ds(c, 1), :, :] = jnp.transpose(
+            jnp.max(val, axis=2))[None]
 
         @pl.when(c == N_CHUNKS - 1)
-        def _topk():
-            # vectorized over ALL queries at once: per-op overhead on this
-            # backend (~1us) dwarfs VPU element throughput, so NCAND big
-            # [QC, 512, 128] passes beat thousands of tiny per-query ops
-            lv = live_blk[...]                            # [512, 128] f32
-            vals = acc[...]                               # [QC, 512, 128]
-            vals = jnp.where((lv[None] > 0) & (vals > 0), vals, -jnp.inf)
-            flat3 = (jax.lax.broadcasted_iota(
-                        jnp.int32, (QC, SW_ROWS, 128), 1) * 128
+        def _toprows():
+            # top-NCAND rows per query by (rowmax desc, row asc) — one
+            # vectorized pass per candidate over the tiny [32, 16, QC]
+            rm = acc_rm[...]                              # [32, 16, QC]
+            rows3 = (jax.lax.broadcasted_iota(
+                        jnp.int32, (N_CHUNKS, CHUNK_ROWS, QC), 0)
+                     * CHUNK_ROWS
                      + jax.lax.broadcasted_iota(
-                        jnp.int32, (QC, SW_ROWS, 128), 2))
+                        jnp.int32, (N_CHUNKS, CHUNK_ROWS, QC), 1))
             big = jnp.int32(1 << 30)
             cand_iota = jax.lax.broadcasted_iota(
-                jnp.int32, (QC, CAND_PAD), 1)
-            all_s = jnp.full((QC, CAND_PAD), -jnp.inf, jnp.float32)
-            all_d = jnp.zeros((QC, CAND_PAD), jnp.int32)
+                jnp.int32, (CAND_PAD, QC), 0)
+            all_m = jnp.full((CAND_PAD, QC), -jnp.inf, jnp.float32)
+            all_r = jnp.zeros((CAND_PAD, QC), jnp.int32)
             for p in range(NCAND):
-                m2 = jnp.max(jnp.max(vals, axis=2), axis=1,
-                             keepdims=True)                     # [QC, 1]
-                at = vals == m2[:, :, None]
-                dmin2 = jnp.min(jnp.min(jnp.where(at, flat3, big), axis=2),
-                                axis=1, keepdims=True)          # [QC, 1]
+                m2 = jnp.max(jnp.max(rm, axis=0), axis=0,
+                             keepdims=True)               # [1, QC]
+                at = rm == m2[None]
+                rmin = jnp.min(jnp.min(jnp.where(at, rows3, big), axis=0),
+                               axis=0, keepdims=True)     # [1, QC]
                 keep = (cand_iota == p) & (m2 > -jnp.inf)
-                all_s = jnp.where(keep, m2, all_s)
-                all_d = jnp.where(keep, dmin2 + sw * SW, all_d)
-                vals = jnp.where(flat3 == dmin2[:, :, None],
-                                 -jnp.inf, vals)
-            out_s[0, :, :] = all_s
-            out_d[0, :, :] = all_d
+                all_m = jnp.where(keep, m2, all_m)
+                all_r = jnp.where(keep, rmin + sw * SW_ROWS, all_r)
+                rm = jnp.where(rows3 == rmin[None], -jnp.inf, rm)
+            out_m[0, :, :] = jnp.transpose(all_m)
+            out_r[0, :, :] = jnp.transpose(all_r)
 
     return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("QC", "nsw"))
-def score_columns(qscale, cols_hi, cols_lo, wq, live, *, QC: int, nsw: int):
-    """Score QC queries against the int8 column cache over nsw superwindows.
+def sweep_rowmax(qscale, cols_hi, cols_lo, wq, live, *, QC: int, nsw: int):
+    """Pass 1: sweep the column cache once for QC queries, emitting each
+    128-doc posting row's max score and, per 65536-doc superwindow, the
+    top-NCAND rows per query.
 
     qscale [QC, 1] f32 — per-query descale factor (qs2 * COLSCALE2)
-    cols_hi/cols_lo [dp_chunks, Hpt, 16, 128] i8 — column layers in
-        CHUNK-MAJOR layout (a 2048-doc chunk of every slot is contiguous,
-        so each grid step's DMA is one run — the slot-major layout made
-        every block 2*Hpt separate 8KB reads and ran at 2% of HBM
-        bandwidth). The last slot is build-padding scratch; its weights
-        are always 0.
+    cols_hi/cols_lo [dp_chunks, Hpt, 16, 128] i8 — chunk-major columns
     wq     [2, QC, Hpt] i8 — hi/lo quantized query weights over slots
-    live   [dp_rows, 128] f32 — 1.0 where the doc is live
+    live   [dp_rows, 128] f32
 
-    Returns (scores [nsw, QC, CAND_PAD] f32, docs [nsw, QC, CAND_PAD] i32):
-    per-superwindow top-NCAND approximate candidates, -inf padded,
-    doc-ascending tie-break.
+    Returns (rowmax [nsw, QC, CAND_PAD] f32, rows [nsw, QC, CAND_PAD] i32)
+    with -inf padding; row ids are global (row * 128 = first doc id).
     """
     Hpt = cols_hi.shape[1]
-    kernel = _score_kernel(QC, Hpt)
+    kernel = _sweep_kernel(QC, Hpt)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
         grid=(nsw, N_CHUNKS),
@@ -152,9 +150,9 @@ def score_columns(qscale, cols_hi, cols_lo, wq, live, *, QC: int, nsw: int):
                          lambda sw, c: (sw * N_CHUNKS + c, 0, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),        # wq
-            pl.BlockSpec((SW_ROWS, 128),
-                         lambda sw, c: (sw, 0),
-                         memory_space=pltpu.VMEM),        # live
+            pl.BlockSpec((CHUNK_ROWS, 128),
+                         lambda sw, c: (sw * N_CHUNKS + c, 0),
+                         memory_space=pltpu.VMEM),        # live chunk
         ],
         out_specs=[
             pl.BlockSpec((1, QC, CAND_PAD), lambda sw, c: (sw, 0, 0),
@@ -163,7 +161,7 @@ def score_columns(qscale, cols_hi, cols_lo, wq, live, *, QC: int, nsw: int):
                          memory_space=pltpu.VMEM),
         ],
         scratch_shapes=[
-            pltpu.VMEM((QC, SW_ROWS, 128), jnp.float32),  # acc
+            pltpu.VMEM((N_CHUNKS, CHUNK_ROWS, QC), jnp.float32),  # acc_rm
         ],
     )
     fn = pl.pallas_call(
@@ -178,6 +176,101 @@ def score_columns(qscale, cols_hi, cols_lo, wq, live, *, QC: int, nsw: int):
         interpret=_interpret(),
     )
     return fn(qscale, cols_hi, cols_lo, wq, live)
+
+
+ROWS_PER_STEP = 8
+
+
+def _resolve_kernel(QC: int, Hpt: int):
+    def kernel(qids, rowids, qscale,
+               *refs):
+        # refs: 8 hi row blocks, 8 lo row blocks, wq, out, (no scratch)
+        hi_rows = refs[:ROWS_PER_STEP]
+        lo_rows = refs[ROWS_PER_STEP:2 * ROWS_PER_STEP]
+        wq = refs[2 * ROWS_PER_STEP]
+        out = refs[2 * ROWS_PER_STEP + 1]
+        g = pl.program_id(0)
+        dn = (((1,), (0,)), ((), ()))
+        sub_iota = jax.lax.broadcasted_iota(
+            jnp.int32, (CHUNK_ROWS, 128), 0)
+        for h in range(ROWS_PER_STEP):
+            q = qids[g * ROWS_PER_STEP + h]
+            sub = rowids[g * ROWS_PER_STEP + h] % CHUNK_ROWS
+            wh = wq[0, pl.ds(q, 1), :]                    # [1, Hpt] i8
+            wl = wq[1, pl.ds(q, 1), :]
+            ch = hi_rows[h][0]                            # [Hpt, 16, 128]
+            cl = lo_rows[h][0]
+            m_hh = jax.lax.dot_general(wh, ch, dn,
+                                       preferred_element_type=jnp.int32)
+            m_hl = jax.lax.dot_general(wh, cl, dn,
+                                       preferred_element_type=jnp.int32)
+            m_lh = jax.lax.dot_general(wl, ch, dn,
+                                       preferred_element_type=jnp.int32)
+            m_ll = jax.lax.dot_general(wl, cl, dn,
+                                       preferred_element_type=jnp.int32)
+            val = (16384.0 * m_hh.astype(jnp.float32)
+                   + 128.0 * (m_hl + m_lh).astype(jnp.float32)
+                   + m_ll.astype(jnp.float32))            # [1, 16, 128]
+            # select the candidate row by mask-reduce (dynamic sublane
+            # indexing is not provably aligned; the extra 15 rows rode the
+            # same MXU pass for free)
+            row = jnp.sum(jnp.where(sub_iota == sub, val[0], 0.0), axis=0)
+            sc = qscale[pl.ds(q, 1), :]                   # [1, 1]
+            out[0, h, :] = row * sc[0]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("n_steps",))
+def resolve_rows(qids, rowids, qscale, cols_hi, cols_lo, wq,
+                 *, n_steps: int):
+    """Pass 2: compute full approximate scores for selected posting rows.
+
+    qids   [n_steps * 8] i32 — owning query of each candidate row
+    rowids [n_steps * 8] i32 — global row ids (row * 128 = first doc)
+    qscale [QC, 1] f32; cols_* as in sweep_rowmax; wq [2, QC, Hpt] i8
+
+    Returns scores [n_steps, 8, 128] f32 (live masking and the >0 cut
+    happen on the host, which owns the live bitmap).
+    """
+    Hpt = cols_hi.shape[1]
+    QC = wq.shape[1]
+    kernel = _resolve_kernel(QC, Hpt)
+
+    def row_spec(h):
+        return pl.BlockSpec(
+            (1, Hpt, CHUNK_ROWS, 128),
+            lambda g, qids, rowids: (
+                rowids[g * ROWS_PER_STEP + h] // CHUNK_ROWS, 0, 0, 0),
+            memory_space=pltpu.VMEM)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_steps,),
+        in_specs=(
+            [pl.BlockSpec((QC, 1), lambda g, *_: (0, 0),
+                          memory_space=pltpu.VMEM)]
+            + [row_spec(h) for h in range(ROWS_PER_STEP)]
+            + [row_spec(h) for h in range(ROWS_PER_STEP)]
+            + [pl.BlockSpec(memory_space=pltpu.VMEM)]
+        ),
+        out_specs=pl.BlockSpec((1, ROWS_PER_STEP, 128),
+                               lambda g, *_: (g, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[],
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_steps, ROWS_PER_STEP, 128),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )
+    args = ([qscale] + [cols_hi] * ROWS_PER_STEP + [cols_lo] * ROWS_PER_STEP
+            + [wq])
+    return fn(qids, rowids, *args)
 
 
 # --------------------------------------------------------------------------

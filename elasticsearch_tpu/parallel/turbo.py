@@ -48,14 +48,15 @@ import numpy as np
 from elasticsearch_tpu.ops import bm25_idf
 from elasticsearch_tpu.parallel.blockmax import _host_block_scores
 from elasticsearch_tpu.parallel.kernels import (
-    CAND_PAD, COLSCALE, COLSCALE2, MAX_GROUP_ROWS, NCAND, SW, TILE,
-    build_columns, score_columns,
+    CAND_PAD, COLSCALE, COLSCALE2, MAX_GROUP_ROWS, NCAND, ROWS_PER_STEP,
+    SW, TILE, build_columns, resolve_rows, sweep_rowmax,
 )
 from elasticsearch_tpu.parallel.spmd import StackedBM25
 
 COLD_DF = 16384        # below this, terms are host-scored
 RESCORE = 20           # device candidates exactly rescored per query
 K1_PLUS1 = 2.2         # BM25 idf-free impact upper bound
+_GLOBAL_ROWS = 33      # candidate posting rows resolved per query
 _BUILD_BUCKETS = (256, 1024, 4096, 16384, 32768)   # last one bounded by
 #   SMEM: 4 prefetch arrays x bucket x 4B must stay well under the 1MB SMEM
 
@@ -88,7 +89,7 @@ class TurboBM25:
 
     def __init__(self, stacked: StackedBM25, *,
                  hbm_budget_bytes: int = 10 << 30,
-                 qc_sizes: Tuple[int, ...] = (8, 20),
+                 qc_sizes: Tuple[int, ...] = (8, 256),
                  fallback: Optional[Callable] = None):
         assert stacked.n_shards == 1, "TurboBM25 v1 serves one partition"
         self.stacked = stacked
@@ -316,7 +317,7 @@ class TurboBM25:
             [t for q in flat for t, _ in q
              if (i := self._term(t)) is not None and i.df >= COLD_DF])
 
-        # dispatch in QC chunks (async; fetch at the end)
+        # pass 1: sweep dispatches (async)
         pending = []
         off = 0
         while off < len(flat):
@@ -324,19 +325,48 @@ class TurboBM25:
             if len(flat) - off <= self.qc_sizes[0]:
                 take = self.qc_sizes[0]
             chunk = flat[off: off + take]
-            pending.append((off, len(chunk),
-                            self._dispatch(chunk, take)))
+            wq, qscale, sweep = self._sweep(chunk, take)
+            pending.append((off, len(chunk), take, wq, qscale, sweep))
             off += len(chunk)
         self.stats["dispatches"] += len(pending)
 
+        # pass 2: pick global candidate rows per query, resolve on device
         out_s = np.zeros((len(flat), k), np.float32)
         out_d = np.zeros((len(flat), k), np.int32)
-        for off, n, (cs, cd) in pending:
-            cs = np.asarray(cs)    # [nsw, QC, CAND_PAD]
-            cd = np.asarray(cd)
+        n_rows = max(_GLOBAL_ROWS, k + 5)
+        for off, n, QC, wq, qscale, (rm_dev, rr_dev) in pending:
+            rm = np.asarray(rm_dev)    # [nsw, QC, CAND_PAD]
+            rr = np.asarray(rr_dev)
+            qids = np.zeros(QC * n_rows, np.int32)
+            rowids = np.zeros(QC * n_rows, np.int32)
+            picks = []                 # per query: (rows, bound_beyond)
             for qi in range(n):
+                m = rm[:, qi, :NCAND].ravel()
+                r = rr[:, qi, :NCAND].ravel()
+                valid = m > -np.inf
+                m, r = m[valid], r[valid]
+                order = np.lexsort((r, -m))
+                top = order[:n_rows]
+                beyond = float(m[order[n_rows]]) if len(order) > n_rows \
+                    else 0.0
+                # rows NOT collected in any sw are bounded by that sw's
+                # NCAND-th kept rowmax
+                sw_last = np.where(rm[:, qi, NCAND - 1] > -np.inf,
+                                   rm[:, qi, NCAND - 1], 0.0)
+                sw_bound = float(sw_last.max()) if len(sw_last) else 0.0
+                picks.append((r[top], max(beyond, sw_bound)))
+                qids[qi * n_rows: qi * n_rows + len(top)] = qi
+                rowids[qi * n_rows: qi * n_rows + len(top)] = r[top]
+            n_steps = -(-(QC * n_rows) // ROWS_PER_STEP)
+            scores = np.asarray(resolve_rows(
+                jnp.asarray(qids), jnp.asarray(rowids), qscale,
+                self.cols_hi, self.cols_lo, wq,
+                n_steps=n_steps)).reshape(-1, 128)
+            for qi in range(n):
+                rows_q, bound_beyond = picks[qi]
+                sc = scores[qi * n_rows: qi * n_rows + len(rows_q)]
                 s, d = self._finish_query(
-                    flat[off + qi], cs[:, qi], cd[:, qi], k)
+                    flat[off + qi], rows_q, sc, bound_beyond, k)
                 out_s[off + qi, : len(s)] = s
                 out_d[off + qi, : len(d)] = d
         return [(out_s[o: o + n], out_d[o: o + n]) for o, n in spans]
@@ -344,7 +374,7 @@ class TurboBM25:
     def search(self, queries: List[List], k: int = 10):
         return self.search_many([queries], k)[0]
 
-    def _dispatch(self, chunk, QC):
+    def _sweep(self, chunk, QC):
         wq = np.zeros((2, QC, self.Hp + 1), np.int8)
         qscale = np.ones((QC, 1), np.float32)
         for qi, terms in enumerate(chunk):
@@ -364,12 +394,19 @@ class TurboBM25:
                 wl = max(-127, min(127, round((w - qs * wh) / qs2)))
                 wq[0, qi, slot] = np.int8(wh)
                 wq[1, qi, slot] = np.int8(wl)
-        return score_columns(
-            jnp.asarray(qscale), self.cols_hi, self.cols_lo,
-            jnp.asarray(wq), self.live, QC=QC, nsw=self.nsw)
+        wq_dev = jnp.asarray(wq)
+        qscale_dev = jnp.asarray(qscale)
+        out = sweep_rowmax(qscale_dev, self.cols_hi, self.cols_lo,
+                           wq_dev, self.live, QC=QC, nsw=self.nsw)
+        return wq_dev, qscale_dev, out
 
-    def _finish_query(self, terms, cand_s, cand_d, k):
-        """Merge device candidates + host cold side into exact top-k."""
+    def _finish_query(self, terms, rows_q, row_scores, bound_beyond, k):
+        """Merge device row candidates + host cold side into exact top-k.
+
+        rows_q [R] global row ids; row_scores [R, 128] approximate scores
+        of those rows' docs (live/positivity not yet applied);
+        bound_beyond — max approximate score any UNRESOLVED row could
+        hold (the global cut + per-superwindow collection bounds)."""
         qterms = []
         cold_terms = []
         col_terms = []
@@ -420,30 +457,27 @@ class TurboBM25:
                 for d, s in zip(docs[pos], totals[pos]):
                     exact_pool[int(d)] = float(s)
 
-        # ---- device side: flatten per-sw candidates, rescore the top ----
-        sw_bound = 0.0
-        if col_terms:
-            valid = cand_s > -np.inf
-            # bound on uncollected colized-only docs: each sw's NCAND-th
-            # (smallest kept) candidate, or 0 where a sw ran dry
-            per_sw_last = np.where(
-                valid[:, NCAND - 1], cand_s[:, NCAND - 1], 0.0)
-            sw_bound = float(per_sw_last.max()) if len(per_sw_last) else 0.0
-            fs = cand_s[valid]
-            fd = cand_d[valid]
+        # ---- device side: resolved candidate rows, rescore the top ----
+        if col_terms and len(rows_q):
+            docs_all = (rows_q.astype(np.int64)[:, None] * 128
+                        + np.arange(128, dtype=np.int64)[None, :]).ravel()
+            sc_all = row_scores[: len(rows_q)].ravel()
+            ok = (sc_all > 0) & (self._live_host[docs_all] > 0)
+            fd, fs = docs_all[ok], sc_all[ok]
             order = np.lexsort((fd, -fs))
             n_rescore = max(RESCORE, k + 5)
             top = order[: n_rescore + 1]
             approx_next = float(fs[top[n_rescore]]) if len(top) > n_rescore \
                 else 0.0
-            rescore_d = fd[top[: n_rescore]].astype(np.int64)
+            approx_next = max(approx_next, float(bound_beyond))
+            rescore_d = fd[top[: n_rescore]]
             if len(rescore_d):
                 ex = self._exact_scores(qterms, rescore_d)
                 for d, s in zip(rescore_d, ex):
                     if s > 0 and int(d) not in exact_pool:
                         exact_pool[int(d)] = float(s)
         else:
-            approx_next = 0.0
+            approx_next = float(bound_beyond) if col_terms else 0.0
 
         if not exact_pool:
             return np.empty(0, np.float32), np.empty(0, np.int32)
@@ -457,7 +491,7 @@ class TurboBM25:
         if col_terms:
             # docs outside the exact pool are bounded by the best score the
             # device could have under-reported plus the quantization error
-            uncollected = max(sw_bound, approx_next)
+            uncollected = approx_next
             bound = uncollected + e_q
             kth = float(out_s[k - 1]) if len(out_s) >= k else 0.0
             short = len(out_s) < k and uncollected > 0
