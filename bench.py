@@ -7,48 +7,70 @@ dense_vector corpus for kNN. One partition on a 1-chip mesh (the driver's
 real-TPU configuration; multi-chip sharding is validated separately by
 dryrun_multichip).
 
-Configs (BASELINE.md):
-  1 match   — 2-term BM25 disjunctions, block-max culled two-pass executor;
-              256-query `_msearch` batches pipelined with 2 round trips
-  2 bool    — must/should/filter conjunctions, the device bool program
-              (coverage-counted segmented sums)
-  3 phrase  — match_phrase slop 0/2 through the columnar positional kernel
-  4 knn     — 768-d cosine brute force on the MXU (bf16 matmul, f32 merge)
-  5 hybrid  — 256 mixed match+knn queries in one pipelined dispatch
+Engine: config 1 runs through `select_bm25_engine` — the SAME selection
+logic the REST serving path uses (search/serving.py; VERDICT r4 item 2) —
+which picks TurboBM25 (int8 column cache + Pallas, parallel/turbo.py) when
+the colizable column set fits the HBM budget and BlockMaxBM25 otherwise.
+The JSON reports which engine served.
+
+Budget discipline (VERDICT r4 item 1 — rc=124 twice is worse than any
+number): the process watches a wall-clock budget (env BENCH_BUDGET_S,
+default 1380 s) and ALWAYS prints its one JSON line:
+
+  * a SIGTERM/SIGALRM handler emits the best-so-far result, so an external
+    `timeout` kill still yields parseable output;
+  * each config checks remaining budget and is skipped (with a reason in
+    the JSON) rather than overrunning;
+  * the built index is cached on disk (.bench_cache/) and XLA compiles in
+    a persistent cache (.jax_cache/), so repeat runs skip the ~5 min build
+    and the compile-bound warmup entirely.
 
 CPU baselines are vectorized NumPy implementations of the SAME semantics —
 sparse posting-merge scoring (BooleanScorer-style doc-id union, C-speed
 memory-bound kernels), per-doc position walking for phrase (PhraseScorer
 doc-at-a-time shape), full f32 matmul for knn. They are the strongest CPU
 implementations we can run in this image (no JVM/Lucene available); all are
-EXACT, so top-k agreement is checked against them. `nproc` is recorded —
-the host gives this benchmark a single core, so absolute CPU numbers are
-one-core numbers.
+EXACT, so top-k agreement is checked against them. The baseline uses every
+core the host grants this process — `nproc` is recorded in the JSON (this
+image grants ONE core, so "all cores" == 1; the JSON says so explicitly
+rather than implying a weaker comparison than it is).
 
 Agreement: config 1 requires IDENTICAL top-10 — same docs, same order
 (doc-id tie-break), scores bit-compared at 1e-6 rel. There is no
-tied-score escape hatch (VERDICT r2 weak #3): the device and CPU paths
-round identically for 2-term queries, so 1.000 is the bar. Configs 2-5
-report agreement with the same doc-order criterion at f32 tolerance
-(>=3-addend sums legitimately differ in rounding order).
+tied-score escape hatch (VERDICT r2 weak #3): the device path rescores its
+candidates in exact f32 with the same term-at-a-time accumulation order as
+the CPU reference, so 1.000 is the bar. Configs 2-5 report agreement with
+the same doc-order criterion at f32 tolerance (>=3-addend sums
+legitimately differ in rounding order).
 
-Prints ONE JSON line; headline metric is config 1 QPS.
+Prints ONE JSON line; headline metric is config 1 QPS with single-query
+(batch=1) p95 latency against the BASELINE.md p95 < 50 ms bar.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
 
 import numpy as np
 
+T_START = time.time()
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 1380))
+REPO = os.path.dirname(os.path.abspath(__file__))
+
 
 def log(msg: str) -> None:
     """Progress to stderr; stdout carries exactly the one JSON line."""
-    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
-          flush=True)
+    print(f"[bench {time.strftime('%H:%M:%S')} +{time.time() - T_START:5.0f}s]"
+          f" {msg}", file=sys.stderr, flush=True)
+
+
+def left() -> float:
+    return BUDGET_S - (time.time() - T_START)
+
 
 N_DOCS = int(os.environ.get("BENCH_DOCS", 10_000_000))
 VOCAB = int(os.environ.get("BENCH_VOCAB", 500_000))
@@ -58,22 +80,109 @@ QUERIES = 256
 K = 10
 ITERS = int(os.environ.get("BENCH_ITERS", 16))
 LAT_SINGLES = 32
-LAT_BATCHES = 8
-CPU_SAMPLE = 64
+LAT_BATCHES = 4
+CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", 64))
+# cold_df tuned for the Zipf corpus: every colizable term's column stays
+# resident (no churn) within the HBM budget; terms below it have <= cold_df
+# postings, which the host scores exactly in microseconds
+COLD_DF = int(os.environ.get("BENCH_COLD_DF", 65536))
+TURBO_HBM = int(os.environ.get("BENCH_TURBO_HBM", 7 << 30))
+
+RESULT = {
+    "metric": "bm25_msearch_qps",
+    "value": 0.0,
+    "unit": "queries/s",
+    "vs_baseline": 0.0,
+    "detail": {"n_docs": N_DOCS, "vocab": VOCAB, "batch": QUERIES, "k": K,
+               "budget_s": BUDGET_S, "nproc": os.cpu_count()},
+}
+_emitted = False
+
+
+def emit(partial: bool) -> None:
+    global _emitted
+    if _emitted:
+        return
+    _emitted = True
+    RESULT["detail"]["partial"] = partial
+    RESULT["detail"]["elapsed_s"] = round(time.time() - T_START, 1)
+    print(json.dumps(RESULT), flush=True)
+
+
+def _on_signal(signum, frame):
+    log(f"signal {signum}: emitting partial result")
+    emit(partial=True)
+    os._exit(0)
+
+
+signal.signal(signal.SIGTERM, _on_signal)
+signal.signal(signal.SIGALRM, _on_signal)
+# insurance: even if a device call wedges, the alarm fires inside the
+# budget and the run still produces output
+signal.alarm(int(max(BUDGET_S - 40, 60)))
 
 
 # --------------------------------------------------------------------------
-# corpus
+# corpus + index (disk-cached)
 # --------------------------------------------------------------------------
 
 
-def build_corpus(rng):
+def _cache_dir() -> str:
+    return os.path.join(REPO, ".bench_cache",
+                        f"idx_{N_DOCS}_{VOCAB}_s42_v1")
+
+
+_FP_ARRAYS = ["doc_freq", "total_term_freq", "block_start", "block_count",
+              "block_docs", "block_tfs", "block_max_tf", "post_start",
+              "post_doc", "pos_start", "pos_data", "doc_len"]
+
+
+def load_or_build_index():
+    """(lens, tokens, fp) — built once, memory-mapped afterwards."""
+    from elasticsearch_tpu.index.segment import FieldPostings, \
+        build_field_postings
+
+    d = _cache_dir()
     probs = 1.0 / np.arange(1, VOCAB + 1) ** 1.07
     probs /= probs.sum()
+    if os.path.isfile(os.path.join(d, "ok")):
+        log("index cache hit...")
+        arrs = {n: np.load(os.path.join(d, n + ".npy"), mmap_mode="r")
+                for n in _FP_ARRAYS}
+        lens = np.load(os.path.join(d, "lens.npy"))
+        tokens = np.load(os.path.join(d, "tokens.npy"), mmap_mode="r")
+        meta = json.load(open(os.path.join(d, "meta.json")))
+        names = [f"t{i}" for i in range(VOCAB)]
+        terms = [names[i] for i in np.load(os.path.join(d, "term_ids.npy"))]
+        fp = FieldPostings(
+            field="body", term_to_ord={t: i for i, t in enumerate(terms)},
+            terms=terms, sum_doc_len=meta["sum_doc_len"], **arrs)
+        return lens, tokens, fp
+
+    rng = np.random.default_rng(42)
+    log("corpus draw...")
     lens = rng.integers(8, 40, size=N_DOCS).astype(np.int64)
     tokens = rng.choice(VOCAB, size=int(lens.sum()), p=probs).astype(np.int64)
+    log("postings build...")
+    names = [f"t{i}" for i in range(VOCAB)]
     bounds = np.concatenate([[0], np.cumsum(lens)])
-    return lens, tokens, bounds, probs
+    tok_docs = np.repeat(np.arange(N_DOCS, dtype=np.int64), lens)
+    tok_pos = np.arange(len(tokens), dtype=np.int64) - bounds[tok_docs]
+    fp = build_field_postings("body", lens, tok_docs, tokens, names,
+                              token_pos=tok_pos)
+    del tok_docs, tok_pos
+    log("index cache write...")
+    os.makedirs(d, exist_ok=True)
+    for n in _FP_ARRAYS:
+        np.save(os.path.join(d, n + ".npy"), getattr(fp, n))
+    np.save(os.path.join(d, "lens.npy"), lens)
+    np.save(os.path.join(d, "tokens.npy"), tokens.astype(np.int32))
+    np.save(os.path.join(d, "term_ids.npy"),
+            np.array([int(t[1:]) for t in fp.terms], np.int64))
+    json.dump({"sum_doc_len": fp.sum_doc_len},
+              open(os.path.join(d, "meta.json"), "w"))
+    open(os.path.join(d, "ok"), "w").write("1")
+    return lens, tokens, fp
 
 
 class _Seg:
@@ -259,256 +368,314 @@ def agreement(dev, cpu, n, *, rtol):
 def main():
     import jax
 
-    from elasticsearch_tpu.index.positions import phrase_freqs  # noqa: F401
-    from elasticsearch_tpu.index.segment import VectorColumn, build_field_postings
-    from elasticsearch_tpu.parallel import build_stacked_bm25, make_mesh
-    from elasticsearch_tpu.parallel.blockmax import BlockMaxBM25
-    from elasticsearch_tpu.parallel.spmd import build_stacked_knn, sharded_knn_topk
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
-    rng = np.random.default_rng(42)
-    detail = {"n_docs": N_DOCS, "vocab": VOCAB, "batch": QUERIES, "k": K,
-              "device": str(jax.devices()[0].platform),
-              "n_devices_visible": len(jax.devices()),
-              "nproc": os.cpu_count()}
+    from elasticsearch_tpu.index.segment import VectorColumn
+    from elasticsearch_tpu.parallel import make_mesh
+    from elasticsearch_tpu.parallel.spmd import build_stacked_knn, \
+        sharded_knn_topk
+    from elasticsearch_tpu.search.serving import select_bm25_engine
 
-    # ---- build ----
-    log("corpus draw...")
+    detail = RESULT["detail"]
+    detail["device"] = str(jax.devices()[0].platform)
+    detail["n_devices_visible"] = len(jax.devices())
+
+    # ---- build (disk-cached) ----
     t0 = time.time()
-    lens, tokens, bounds, probs = build_corpus(rng)
-    detail["corpus_draw_s"] = round(time.time() - t0, 1)
-    t0 = time.time()
-    log("postings build...")
-    names = [f"t{i}" for i in range(VOCAB)]
-    tok_docs = np.repeat(np.arange(N_DOCS, dtype=np.int64), lens)
-    tok_pos = np.arange(len(tokens), dtype=np.int64) - bounds[tok_docs]
-    fp = build_field_postings("body", lens, tok_docs, tokens, names,
-                              token_pos=tok_pos)
-    del tok_docs, tok_pos
+    lens, tokens, fp = load_or_build_index()
     detail["index_build_s"] = round(time.time() - t0, 1)
+    bounds = np.concatenate([[0], np.cumsum(lens)])
+
     t0 = time.time()
-    log("device stack...")
+    log("engine build (select_bm25_engine, the serving path's selector)...")
     seg = _Seg(N_DOCS, fp)
     mesh = make_mesh(1, dp=1)
-    stacked = build_stacked_bm25([seg], "body", mesh=mesh, serve_only=True)
-    serving = BlockMaxBM25(stacked, mesh)
+    eng = select_bm25_engine([seg], "body", None, mesh,
+                             hbm_budget_bytes=TURBO_HBM, cold_df=COLD_DF)
+    detail["engine"] = eng.kind
     detail["stack_device_s"] = round(time.time() - t0, 1)
-    detail["hbm_index_bytes"] = int(serving.hbm_bytes())
+    detail["hbm_index_bytes"] = int(eng.hbm_bytes())
+    if eng.kind == "turbo":
+        avgdl = eng.turbos[0]._avgdl
+        total_docs = eng.turbos[0]._total_docs
+    else:
+        avgdl = eng.stacked.avgdl
+        total_docs = eng.stacked.total_docs
 
-    qprobs = probs
-
-    def draw_terms(n_terms, size):
-        return rng.choice(VOCAB, size=(size, n_terms), p=qprobs)
+    rng = np.random.default_rng(43)
+    probs = 1.0 / np.arange(1, VOCAB + 1) ** 1.07
+    probs /= probs.sum()
 
     def draw_batch(n=QUERIES):
-        t = draw_terms(2, n)
+        t = rng.choice(VOCAB, size=(n, 2), p=probs)
         t[:, 1] = np.where(t[:, 1] == t[:, 0], (t[:, 1] + 1) % VOCAB, t[:, 1])
         return [[f"t{a}", f"t{b}"] for a, b in t]
 
-    cpu = CpuSparseBM25(fp, stacked.avgdl, stacked.total_docs)
+    cpu = CpuSparseBM25(fp, avgdl, total_docs)
 
-    log("config1 warmup...")
     # ================= config 1: match =================
-    # warmup must cover every program shape the timed phases hit: full
-    # batches (nominal Qc per bucket, hot and lane-only) AND singles (Qc=8)
+    log(f"config1 warmup ({eng.kind})...")
     t0 = time.time()
-    for _ in range(3):
-        serving.search_many([draw_batch() for _ in range(2)], k=K)
-    for q in draw_batch(6):
-        serving.search_many([[q]], k=K)
+    if eng.kind == "turbo":
+        detail["n_columns"] = eng.prebuild_columns()   # no builds in timing
+    eng.search_many([draw_batch()], k=K)          # batch shape
+    eng.search_many([[draw_batch(1)[0]]], k=K)    # single shape
     detail["config1_warmup_s"] = round(time.time() - t0, 1)
 
-    log("config1 throughput...")
-    batches = [draw_batch() for _ in range(ITERS)]
-    t0 = time.time()
-    serving.search_many(batches, k=K)
-    match_qps = QUERIES * ITERS / (time.time() - t0)
-
-    # single-query latency (batch=1): the p95 < 50ms bar is PER SEARCH
+    # single-query latency FIRST (the p95 < 50ms bar is PER SEARCH and must
+    # land in the JSON even if throughput gets cut short)
     log("config1 latency singles...")
-    singles = draw_batch(LAT_SINGLES)
     lat1 = []
-    for q in singles:
+    for q in draw_batch(LAT_SINGLES):
         t1 = time.time()
-        serving.search_many([[q]], k=K)
+        eng.search_many([[q]], k=K)
         lat1.append(time.time() - t1)
+    c1 = {
+        "latency_ms_batch1_p50": round(pct(lat1, 50), 1),
+        "latency_ms_batch1_p95": round(pct(lat1, 95), 1),
+    }
+    detail["config1_match"] = c1
+
+    log("config1 throughput...")
+    t1batch = time.time()
+    eng.search_many([draw_batch()], k=K)
+    batch_s = time.time() - t1batch
+    # fit the measured loop inside the remaining budget: leave room for the
+    # CPU baseline (+agreement) and the later configs
+    iters = max(2, min(ITERS, int((left() * 0.25) / max(batch_s, 1e-3))))
+    batches = [draw_batch() for _ in range(iters)]
+    t0 = time.time()
+    eng.search_many(batches, k=K)
+    match_qps = QUERIES * iters / (time.time() - t0)
+
     lat256 = []
     for _ in range(LAT_BATCHES):
         b = draw_batch()
         t1 = time.time()
-        serving.search_many([b], k=K)
+        eng.search_many([b], k=K)
         lat256.append(time.time() - t1)
-    phases = {p: round(v, 4) for p, v in serving.last_timing.items()
-              if isinstance(v, float)}
 
     log("config1 cpu baseline + agreement...")
     sample = draw_batch()
-    dev_s, _, dev_o = serving.search_many([sample], k=K)[0]
+    dev_s, _, dev_o = eng.search_many([sample], k=K)[0]
+    n_cpu = min(CPU_SAMPLE, QUERIES)
     t0 = time.time()
-    cpu_results = [cpu.search(q) for q in sample[:CPU_SAMPLE]]
-    cpu_match_qps = CPU_SAMPLE / (time.time() - t0)
-    cpu_results += [cpu.search(q) for q in sample[CPU_SAMPLE:]]
-    match_agree = agreement((dev_s, dev_o), cpu_results, QUERIES, rtol=1e-6)
+    cpu_results = [cpu.search(q) for q in sample[:n_cpu]]
+    cpu_match_qps = n_cpu / (time.time() - t0)
+    match_agree = agreement((dev_s, dev_o), cpu_results, n_cpu, rtol=1e-6)
 
-    detail["config1_match"] = {
+    c1.update({
         "qps": round(match_qps, 1),
-        "cpu_qps": round(cpu_match_qps, 1),
+        "iters_x_batch": f"{iters}x{QUERIES}",
+        "cpu_qps": round(cpu_match_qps, 2),
         "vs_cpu": round(match_qps / cpu_match_qps, 2),
-        "latency_ms_batch1_p50": round(pct(lat1, 50), 1),
-        "latency_ms_batch1_p95": round(pct(lat1, 95), 1),
         "latency_ms_batch256_p50": round(pct(lat256, 50), 1),
         "latency_ms_batch256_p95": round(pct(lat256, 95), 1),
         "top10_agreement": round(match_agree, 4),
-        "phase_seconds_batch256": phases,
-        "cpu_algorithm": "sparse-posting-merge-numpy (1 core)",
-    }
+        "agreement_sample": n_cpu,
+        "cpu_algorithm":
+            f"sparse-posting-merge-numpy on all granted cores "
+            f"(nproc={os.cpu_count()})",
+    })
+    if eng.kind == "turbo":
+        c1["engine_stats"] = {k_: round(v, 3) if isinstance(v, float) else v
+                              for k_, v in eng.stats.items()}
+    RESULT["value"] = round(match_qps, 1)
+    RESULT["vs_baseline"] = round(match_qps / cpu_match_qps, 2)
+    log(f"config1: {match_qps:.1f} qps, {RESULT['vs_baseline']}x cpu, "
+        f"agreement {match_agree}, p95(1) {c1['latency_ms_batch1_p95']}ms")
 
-    # ================= config 2: bool =================
-    def draw_bool(n):
-        """Half SELECTIVE conjunctions (mid-freq must -> host sparse path),
-        half HEAVY ones (two head-term musts -> device program): the
-        executor choice is part of what config 2 measures."""
-        head = rng.integers(0, 100, size=(n, 2))
-        mid = rng.integers(200, 20_000, size=(n, 2))
-        tail = rng.integers(20_000, VOCAB, size=(n, 1))
-        out = []
-        for i in range(n):
-            if i % 2 == 0:
-                out.append({
-                    "must": [(f"t{mid[i, 0]}", 1.0)],
-                    "should": [(f"t{head[i, 0]}", 1.0), (f"t{tail[i, 0]}", 1.0)],
-                    "filter": [f"t{mid[i, 1]}"] if i % 4 == 0 else [],
-                })
-            else:
-                out.append({
-                    "must": [(f"t{head[i, 0]}", 1.0), (f"t{head[i, 1]}", 1.0)],
-                    "should": [(f"t{mid[i, 0]}", 1.0)],
-                })
-        return out
+    # ================= config 4: knn (cheap; before the host-heavy ones) ==
+    if left() > 180:
+        try:
+            log("config4 knn build...")
+            t0 = time.time()
+            krng = np.random.default_rng(7)
+            vecs = krng.standard_normal((KNN_DOCS, KNN_DIMS), dtype=np.float32)
+            vc = VectorColumn(vectors=vecs,
+                              norms=np.linalg.norm(vecs, axis=1).astype(np.float32),
+                              exists=np.ones(KNN_DOCS, bool), dims=KNN_DIMS,
+                              similarity="cosine")
+            kseg = _Seg(KNN_DOCS, vectors={"emb": vc})
+            kst = build_stacked_knn([kseg], "emb", mesh=mesh)
+            kbuild = round(time.time() - t0, 1)
+            kq = krng.standard_normal((QUERIES, KNN_DIMS)).astype(np.float32)
+            sharded_knn_topk(mesh, kst, kq, k=K)   # warmup at timed shape
+            t0 = time.time()
+            k_s, _, k_o = sharded_knn_topk(mesh, kst, kq, k=K)
+            knn_wall = time.time() - t0
 
-    log("config2 bool...")
-    bool_qs = draw_bool(QUERIES)
-    serving.search_bool(draw_bool(QUERIES), k=K)      # warmup all shapes
-    t0 = time.time()
-    b_s, _, b_o = serving.search_bool(bool_qs, k=K)
-    bool_wall = time.time() - t0
-    t0 = time.time()
-    cpu_bool = [cpu.search_bool(q) for q in bool_qs[:CPU_SAMPLE]]
-    cpu_bool_qps = CPU_SAMPLE / (time.time() - t0)
-    cpu_bool += [cpu.search_bool(q) for q in bool_qs[CPU_SAMPLE:]]
-    detail["config2_bool"] = {
-        "qps": round(QUERIES / bool_wall, 1),
-        "cpu_qps": round(cpu_bool_qps, 1),
-        "vs_cpu": round(QUERIES / bool_wall / cpu_bool_qps, 2),
-        "top10_agreement": round(
-            agreement((b_s, b_o), cpu_bool, QUERIES, rtol=2e-5), 4),
-    }
+            def cpu_knn(q):
+                dots = vecs @ q                          # f32 BLAS
+                qn = np.float32(np.linalg.norm(q))
+                sc = (1.0 + dots / np.maximum(qn * vc.norms, 1e-20)) / 2.0
+                sel = np.argpartition(-sc, K)[:K]
+                sel = sel[np.lexsort((sel, -sc[sel]))]
+                return sel.astype(np.int64), sc[sel].astype(np.float32)
+
+            t0 = time.time()
+            cpu_kres = [cpu_knn(q) for q in kq[:16]]
+            cpu_knn_qps = 16 / (time.time() - t0)
+            cpu_kres += [cpu_knn(q) for q in kq[16:]]
+            overlap = 0
+            for qi in range(QUERIES):
+                overlap += len(set(k_o[qi].astype(int))
+                               & set(cpu_kres[qi][0].astype(int)))
+            detail["config4_knn"] = {
+                "qps": round(QUERIES / knn_wall, 1),
+                "cpu_qps": round(cpu_knn_qps, 1),
+                "vs_cpu": round(QUERIES / knn_wall / cpu_knn_qps, 2),
+                "recall_at_10": round(overlap / (QUERIES * K), 4),
+                "n_vectors": KNN_DOCS, "dims": KNN_DIMS, "build_s": kbuild,
+                "note": "device scores bf16 matmul (f32 accumulate); "
+                        "recall vs exact f32 CPU",
+            }
+
+            # ============= config 5: hybrid msearch =============
+            half = QUERIES // 2
+            log("config5 hybrid...")
+            m_batch = draw_batch(half)
+            h_kq = kq[:half]
+            eng.search_many([m_batch], k=K)        # warm half-batch shapes
+            sharded_knn_topk(mesh, kst, h_kq, k=K)
+            t0 = time.time()
+            eng.search_many([m_batch], k=K)
+            sharded_knn_topk(mesh, kst, h_kq, k=K)
+            hybrid_wall = time.time() - t0
+            cpu_hybrid_qps = 2.0 / (1.0 / cpu_match_qps + 1.0 / cpu_knn_qps)
+            detail["config5_hybrid"] = {
+                "qps": round(QUERIES / hybrid_wall, 1),
+                "cpu_qps": round(cpu_hybrid_qps, 1),
+                "vs_cpu": round(QUERIES / hybrid_wall / cpu_hybrid_qps, 2),
+                "mix": f"{half} match + {half} knn",
+            }
+            del vecs, kst
+        except Exception as e:   # noqa: BLE001 — a config must not kill the run
+            detail["config4_knn"] = {"error": repr(e)[:300]}
+    else:
+        detail["config4_knn"] = {"skipped": "budget"}
+
+    # ================= config 2: bool (BlockMax device program) ==========
+    bmx = eng if eng.kind == "blockmax" else None
+
+    def blockmax_engine():
+        nonlocal bmx
+        if bmx is None:
+            from elasticsearch_tpu.parallel.blockmax import BlockMaxBM25
+            from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+            stacked = build_stacked_bm25([seg], "body", mesh=mesh,
+                                         serve_only=True)
+            bmx = BlockMaxBM25(stacked, mesh)
+        return bmx
+
+    if left() > 240:
+        try:
+            log("config2 bool (blockmax executor)...")
+            bmx2 = blockmax_engine()
+
+            def draw_bool(n):
+                """Half SELECTIVE conjunctions (mid-freq must -> host sparse
+                path), half HEAVY ones (two head-term musts -> device
+                program): the executor choice is part of what config 2
+                measures."""
+                h_hi = max(2, min(100, VOCAB // 100))
+                m_hi = max(2 * h_hi + 2, min(20_000, VOCAB // 2))
+                head = rng.integers(0, h_hi, size=(n, 2))
+                mid = rng.integers(2 * h_hi, m_hi, size=(n, 2))
+                tail = rng.integers(m_hi, VOCAB, size=(n, 1))
+                out = []
+                for i in range(n):
+                    if i % 2 == 0:
+                        out.append({
+                            "must": [(f"t{mid[i, 0]}", 1.0)],
+                            "should": [(f"t{head[i, 0]}", 1.0),
+                                       (f"t{tail[i, 0]}", 1.0)],
+                            "filter": [f"t{mid[i, 1]}"] if i % 4 == 0 else [],
+                        })
+                    else:
+                        out.append({
+                            "must": [(f"t{head[i, 0]}", 1.0),
+                                     (f"t{head[i, 1]}", 1.0)],
+                            "should": [(f"t{mid[i, 0]}", 1.0)],
+                        })
+                return out
+
+            bool_qs = draw_bool(QUERIES)
+            bmx2.search_bool(draw_bool(QUERIES), k=K)     # warmup all shapes
+            t0 = time.time()
+            b_s, _, b_o = bmx2.search_bool(bool_qs, k=K)
+            bool_wall = time.time() - t0
+            n_cpu = min(CPU_SAMPLE, QUERIES)
+            t0 = time.time()
+            cpu_bool = [cpu.search_bool(q) for q in bool_qs[:n_cpu]]
+            cpu_bool_qps = n_cpu / (time.time() - t0)
+            detail["config2_bool"] = {
+                "qps": round(QUERIES / bool_wall, 1),
+                "cpu_qps": round(cpu_bool_qps, 1),
+                "vs_cpu": round(QUERIES / bool_wall / cpu_bool_qps, 2),
+                "top10_agreement": round(
+                    agreement((b_s, b_o), cpu_bool, n_cpu, rtol=2e-5), 4),
+                "agreement_sample": n_cpu,
+            }
+        except Exception as e:   # noqa: BLE001
+            detail["config2_bool"] = {"error": repr(e)[:300]}
+    else:
+        detail["config2_bool"] = {"skipped": "budget"}
 
     # ================= config 3: phrase =================
-    def draw_phrases(n, max_df=200_000):
-        out = []
-        while len(out) < n:
-            d = int(rng.integers(0, N_DOCS))
-            lo, hi = int(bounds[d]), int(bounds[d + 1])
-            if hi - lo < 2:
-                continue
-            j = int(rng.integers(lo, hi - 1))
-            a, b = int(tokens[j]), int(tokens[j + 1])
-            if a == b:
-                continue
-            if max(fp.doc_freq[a], fp.doc_freq[b]) > max_df:
-                continue   # cap the CPU baseline's candidate walk
-            out.append([f"t{a}", f"t{b}"])
-        return out
+    if left() > 180:
+        try:
+            log("config3 phrase...")
 
-    log("config3 phrase...")
-    phrases = draw_phrases(QUERIES)
-    cpu_phrase = CpuPhrase(fp, stacked.avgdl, stacked.total_docs)
-    results = {}
-    for slop in (0, 2):
-        serving.search_phrase(phrases[:8], k=K, slop=slop)   # warm caches
-        t0 = time.time()
-        p_s, _, p_o = serving.search_phrase(phrases, k=K, slop=slop)
-        wall = time.time() - t0
-        t0 = time.time()
-        cpu_res = [cpu_phrase.search(q, slop=slop) for q in phrases[:CPU_SAMPLE]]
-        cpu_qps = CPU_SAMPLE / (time.time() - t0)
-        cpu_res += [cpu_phrase.search(q, slop=slop) for q in phrases[CPU_SAMPLE:]]
-        results[f"slop{slop}"] = {
-            "qps": round(QUERIES / wall, 1),
-            "cpu_qps": round(cpu_qps, 1),
-            "vs_cpu": round(QUERIES / wall / cpu_qps, 2),
-            "top10_agreement": round(
-                agreement((p_s, p_o), cpu_res, QUERIES, rtol=2e-5), 4),
-        }
-    detail["config3_phrase"] = results
+            def draw_phrases(n, max_df=200_000):
+                out = []
+                while len(out) < n:
+                    d = int(rng.integers(0, N_DOCS))
+                    lo, hi = int(bounds[d]), int(bounds[d + 1])
+                    if hi - lo < 2:
+                        continue
+                    j = int(rng.integers(lo, hi - 1))
+                    a, b = int(tokens[j]), int(tokens[j + 1])
+                    if a == b:
+                        continue
+                    oa, ob = fp.term_to_ord[f"t{a}"], fp.term_to_ord[f"t{b}"]
+                    if max(fp.doc_freq[oa], fp.doc_freq[ob]) > max_df:
+                        continue   # cap the CPU baseline's candidate walk
+                    out.append([f"t{a}", f"t{b}"])
+                return out
 
-    # ================= config 4: knn =================
-    log("config4 knn build...")
-    t0 = time.time()
-    vecs = rng.standard_normal((KNN_DOCS, KNN_DIMS), dtype=np.float32)
-    vc = VectorColumn(vectors=vecs, norms=np.linalg.norm(vecs, axis=1).astype(np.float32),
-                      exists=np.ones(KNN_DOCS, bool), dims=KNN_DIMS,
-                      similarity="cosine")
-    kseg = _Seg(KNN_DOCS, vectors={"emb": vc})
-    kst = build_stacked_knn([kseg], "emb", mesh=mesh)
-    detail["knn_build_s"] = round(time.time() - t0, 1)
-    kq = rng.standard_normal((QUERIES, KNN_DIMS)).astype(np.float32)
-    sharded_knn_topk(mesh, kst, kq, k=K)   # warmup at the TIMED shape
-    t0 = time.time()
-    k_s, _, k_o = sharded_knn_topk(mesh, kst, kq, k=K)
-    knn_wall = time.time() - t0
+            # phrase runs on the blockmax/host positional executor
+            bmx3 = blockmax_engine()
+            phrases = draw_phrases(QUERIES)
+            cpu_phrase = CpuPhrase(fp, avgdl, total_docs)
+            results = {}
+            n_cpu = min(CPU_SAMPLE, QUERIES)
+            for slop in (0, 2):
+                t0 = time.time()
+                p_s, _, p_o = bmx3.search_phrase(phrases, k=K, slop=slop)
+                wall = time.time() - t0
+                t0 = time.time()
+                cpu_res = [cpu_phrase.search(q, slop=slop)
+                           for q in phrases[:n_cpu]]
+                cpu_qps = n_cpu / (time.time() - t0)
+                results[f"slop{slop}"] = {
+                    "qps": round(QUERIES / wall, 1),
+                    "cpu_qps": round(cpu_qps, 1),
+                    "vs_cpu": round(QUERIES / wall / cpu_qps, 2),
+                    "top10_agreement": round(
+                        agreement((p_s, p_o), cpu_res, n_cpu, rtol=2e-5), 4),
+                    "agreement_sample": n_cpu,
+                }
+            detail["config3_phrase"] = results
+        except Exception as e:   # noqa: BLE001
+            detail["config3_phrase"] = {"error": repr(e)[:300]}
+    else:
+        detail["config3_phrase"] = {"skipped": "budget"}
 
-    def cpu_knn(q):
-        dots = vecs @ q                          # f32 BLAS
-        qn = np.float32(np.linalg.norm(q))
-        sc = (1.0 + dots / np.maximum(qn * vc.norms, 1e-20)) / 2.0
-        sel = np.argpartition(-sc, K)[:K]
-        sel = sel[np.lexsort((sel, -sc[sel]))]
-        return sel.astype(np.int64), sc[sel].astype(np.float32)
-
-    t0 = time.time()
-    cpu_kres = [cpu_knn(q) for q in kq[:16]]
-    cpu_knn_qps = 16 / (time.time() - t0)
-    cpu_kres += [cpu_knn(q) for q in kq[16:]]
-    # bf16 matmul vs f32 CPU: scores differ in the 3rd decimal; compare doc
-    # RECALL (overlap of top-10 sets) plus order-insensitive score closeness
-    overlap = 0
-    for qi in range(QUERIES):
-        overlap += len(set(k_o[qi].astype(int)) & set(cpu_kres[qi][0].astype(int)))
-    detail["config4_knn"] = {
-        "qps": round(QUERIES / knn_wall, 1),
-        "cpu_qps": round(cpu_knn_qps, 1),
-        "vs_cpu": round(QUERIES / knn_wall / cpu_knn_qps, 2),
-        "recall_at_10": round(overlap / (QUERIES * K), 4),
-        "n_vectors": KNN_DOCS, "dims": KNN_DIMS,
-        "note": "device scores bf16 matmul (f32 accumulate); recall vs exact f32 CPU",
-    }
-
-    # ================= config 5: hybrid msearch =================
-    log("config5 hybrid...")
-    half = QUERIES // 2
-    m_batch = draw_batch(half)
-    h_kq = kq[:half]
-    t0 = time.time()
-    serving.search_many([m_batch], k=K)
-    sharded_knn_topk(mesh, kst, h_kq, k=K)
-    hybrid_wall = time.time() - t0
-    cpu_hybrid_qps = 2.0 / (1.0 / cpu_match_qps + 1.0 / cpu_knn_qps)
-    detail["config5_hybrid"] = {
-        "qps": round(QUERIES / hybrid_wall, 1),
-        "cpu_qps": round(cpu_hybrid_qps, 1),
-        "vs_cpu": round(QUERIES / hybrid_wall / cpu_hybrid_qps, 2),
-        "mix": f"{half} match + {half} knn",
-    }
-
-    result = {
-        "metric": "bm25_msearch_qps",
-        "value": round(match_qps, 1),
-        "unit": "queries/s",
-        "vs_baseline": round(match_qps / cpu_match_qps, 2),
-        "detail": detail,
-    }
-    print(json.dumps(result))
+    emit(partial=False)
 
 
 if __name__ == "__main__":

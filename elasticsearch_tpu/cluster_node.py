@@ -42,6 +42,7 @@ from elasticsearch_tpu.indices.cluster_state_service import (
 )
 from elasticsearch_tpu.indices.shard_service import (
     DistributedShardService, PrimaryTermMismatchError, ShardNotFoundError,
+    _ops_bytes,
 )
 from elasticsearch_tpu.parallel.routing import shard_for_id
 from elasticsearch_tpu.transport.channels import (
@@ -263,7 +264,8 @@ class ClusterNode:
                         primary.node_id, "indices:data/write/bulk[s]",
                         {"index": index, "shard_id": sid,
                          "primary_term": state.indices[index].primary_term(sid),
-                         "ops": payload_ops})
+                         "ops": payload_ops,
+                         "ops_bytes": _ops_bytes(payload_ops)})
                     break
                 except (NodeUnavailableError, ShardNotFoundError,
                         PrimaryTermMismatchError) as e:

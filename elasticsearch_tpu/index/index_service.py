@@ -297,6 +297,12 @@ class IndexService:
         finalize_hits_envelope(resp, request)
         if aggs is not None:
             resp["aggregations"] = aggs
+        if request.get("suggest") is not None:
+            from elasticsearch_tpu.search.suggest import execute_suggest
+
+            resp["suggest"] = execute_suggest(
+                [v for se in searchers for v in se.views], self.mapper,
+                request["suggest"])
         if any(r.terminated_early for r in shard_results):
             resp["terminated_early"] = True
         if request.get("profile"):

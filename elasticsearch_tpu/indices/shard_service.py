@@ -40,6 +40,18 @@ from elasticsearch_tpu.transport.channels import NodeChannels, NodeUnavailableEr
 from elasticsearch_tpu.transport.service import TransportService
 
 
+def _ops_bytes(ops) -> int:
+    """Byte estimate of a bulk ops payload for IndexingPressure accounting
+    (source sizes dominate; metadata gets a flat allowance)."""
+    import json as _json
+
+    total = 0
+    for op in ops:
+        src = op.get("source")
+        total += 64 + (len(_json.dumps(src)) if src is not None else 0)
+    return total
+
+
 class ShardNotFoundError(ElasticsearchTpuError):
     status = 404
     error_type = "shard_not_found_exception"
@@ -85,7 +97,8 @@ class DistributedShardService:
     def __init__(self, node_name: str, transport: TransportService,
                  channels: NodeChannels,
                  master_client: Callable[[str, dict], dict],
-                 data_path: Optional[str] = None):
+                 data_path: Optional[str] = None,
+                 indexing_pressure=None):
         self.node_name = node_name
         self.transport = transport
         self.channels = channels
@@ -94,6 +107,11 @@ class DistributedShardService:
         self.shards: Dict[Tuple[str, int], ShardInstance] = {}
         self.state: ClusterState = ClusterState()
         self._registry_lock = threading.Lock()
+        from elasticsearch_tpu.common.indexing_pressure import IndexingPressure
+
+        # per-node write backpressure (ref: index/IndexingPressure.java) —
+        # injectable so all of a node's stages share ONE budget
+        self.indexing_pressure = indexing_pressure or IndexingPressure()
         t = transport
         t.register_request_handler("indices:data/write/bulk[s]",
                                    self._on_primary_bulk)
@@ -168,7 +186,8 @@ class DistributedShardService:
             raise PrimaryTermMismatchError(
                 f"request term [{req_term}] below current "
                 f"[{inst.primary_term}]")
-        with inst.lock:
+        ops_bytes = p.get("ops_bytes") or _ops_bytes(p["ops"])
+        with self.indexing_pressure.primary(ops_bytes), inst.lock:
             results: List[dict] = []
             rep_ops: List[dict] = []
             for op in p["ops"]:
@@ -197,14 +216,15 @@ class DistributedShardService:
                 except VersionConflictError as e:
                     results.append({"_id": op["id"], "status": 409,
                                     "error": e.to_dict()})
-            self._replicate(inst, rep_ops)
+            self._replicate(inst, rep_ops, ops_bytes)
             inst.tracker.update_local_checkpoint(
                 inst.allocation_id, inst.engine.local_checkpoint)
             return {"results": results,
                     "local_checkpoint": inst.engine.local_checkpoint,
                     "global_checkpoint": inst.tracker.global_checkpoint}
 
-    def _replicate(self, inst: ShardInstance, rep_ops: List[dict]) -> None:
+    def _replicate(self, inst: ShardInstance, rep_ops: List[dict],
+                   ops_bytes: Optional[int] = None) -> None:
         """Fan one op batch to every assigned copy (ref:
         ReplicationOperation.java:137 performOnReplicas). In-sync copy
         failure -> shard-failed to master; a still-recovering copy may miss
@@ -224,6 +244,7 @@ class DistributedShardService:
                     r.node_id, "indices:data/write/bulk[s][r]",
                     {"index": inst.index, "shard_id": inst.shard_id,
                      "primary_term": inst.primary_term, "ops": rep_ops,
+                     "ops_bytes": ops_bytes,
                      "global_checkpoint": gcp})
                 inst.tracker.update_local_checkpoint(
                     r.allocation_id, resp["local_checkpoint"])
@@ -253,7 +274,8 @@ class DistributedShardService:
             raise PrimaryTermMismatchError(
                 f"replication from deposed primary (term [{term}] < "
                 f"[{inst.primary_term}])")
-        with inst.lock:
+        ops_bytes = p.get("ops_bytes") or _ops_bytes(p["ops"])
+        with self.indexing_pressure.replica(ops_bytes), inst.lock:
             inst.primary_term = max(inst.primary_term, term)
             for op in p["ops"]:
                 if op["op"] == "index":

@@ -283,9 +283,77 @@ def _json_str(value: Any) -> str:
     return str(value)
 
 
+class JoinFieldType(FieldType):
+    """Parent-join relations (ref: modules/parent-join/
+    ParentJoinFieldMapper.java). The field's own keyword value is the
+    relation NAME (term-searchable, like the reference); a child doc's
+    parent id lands in the hidden `<name>.__parent` keyword sidecar.
+    Parent and child must share a shard (routing by parent id), exactly
+    the reference's constraint."""
+
+    family = "join"
+
+    def __init__(self, name: str, params: dict):
+        super().__init__(name, params)
+        rels = params.get("relations", {}) or {}
+        self.relations = rels
+        self.parent_of: dict[str, str] = {}
+        for p, cs in rels.items():
+            for c in ([cs] if isinstance(cs, str) else cs):
+                self.parent_of[c] = p
+
+    def parse_join_value(self, value):
+        """(relation_name, parent_id|None), validated."""
+        if isinstance(value, str):
+            name, parent = value, None
+        elif isinstance(value, dict):
+            name = value.get("name")
+            parent = value.get("parent")
+        else:
+            raise MapperParsingError(
+                f"join field [{self.name}] expects a name or object")
+        known = set(self.relations) | set(self.parent_of)
+        if name not in known:
+            raise MapperParsingError(
+                f"unknown join name [{name}] for field [{self.name}]")
+        if name in self.parent_of and parent is None:
+            raise MapperParsingError(
+                f"[parent] is missing for join field [{self.name}]")
+        return name, (None if parent is None else str(parent))
+
+    def index_terms(self, value, analyzer=None):
+        return []
+
+
+class PercolatorFieldType(FieldType):
+    """Stored-query field (ref: modules/percolator/
+    PercolatorFieldMapper.java). The query JSON stays in _source; index
+    time extracts its terms into a hidden `<name>.__terms` keyword sidecar
+    for candidate prefiltering (search/percolate.py)."""
+
+    family = "percolator"
+
+    def index_terms(self, value, analyzer=None):
+        return []
+
+
+class CompletionFieldType(FieldType):
+    """Completion-suggester input field (ref: CompletionFieldMapper.java).
+    The suggester builds its per-segment sorted prefix arrays from stored
+    _source values (search/suggest.py); no postings are indexed."""
+
+    family = "completion"
+
+    def index_terms(self, value, analyzer=None):
+        return []
+
+
 _TYPES = {
     "text": TextFieldType,
     "keyword": KeywordFieldType,
+    "completion": CompletionFieldType,
+    "percolator": PercolatorFieldType,
+    "join": JoinFieldType,
     "date": DateFieldType,
     "boolean": BooleanFieldType,
     "ip": IpFieldType,

@@ -119,6 +119,14 @@ class MapperService:
     def field_type(self, name: str) -> FieldType | None:
         return self._field_types.get(name)
 
+    def join_field(self) -> FieldType | None:
+        """The index's single join field, if mapped (the reference allows
+        at most one, ParentJoinFieldMapper.java)."""
+        for ft in self._field_types.values():
+            if ft.family == "join":
+                return ft
+        return None
+
     def field_names(self) -> List[str]:
         return sorted(self._field_types)
 
@@ -165,6 +173,33 @@ class MapperService:
                                       source=child_obj)
                     self._parse_obj(f"{full}.", child_obj, child, dyn)
                     children.append(child)
+                continue
+            if known is not None and known.family == "completion":
+                # {"input": [...], "weight": n} shapes are suggester data
+                # read from _source (search/suggest.py), not sub-objects
+                continue
+            if known is not None and known.family == "join":
+                name, parent = known.parse_join_value(value)
+                doc.keyword.setdefault(full, []).append(name)
+                if parent is not None:
+                    doc.keyword.setdefault(f"{full}.__parent",
+                                           []).append(parent)
+                continue
+            if known is not None and known.family == "percolator":
+                # stored query: extract candidate-prefilter terms into the
+                # hidden keyword sidecar (ref: PercolatorFieldMapper
+                # processQuery -> extraction fields)
+                from elasticsearch_tpu.search.percolate import (
+                    query_index_tokens,
+                )
+
+                if not isinstance(value, dict):
+                    raise MapperParsingError(
+                        f"percolator field [{full}] must hold a query object")
+                # an empty token list (match_none) means never-candidate
+                toks = query_index_tokens(self, value)
+                if toks:
+                    doc.keyword.setdefault(f"{full}.__terms", []).extend(toks)
                 continue
             if isinstance(value, dict) and not (
                     known is not None and known.family == "geo"):

@@ -35,6 +35,12 @@ class Node:
             nodes={self.node_id: node},
         )
         self.breakers = HierarchyCircuitBreakerService()
+        from elasticsearch_tpu.common.indexing_pressure import (
+            DEFAULT_LIMIT_BYTES, IndexingPressure,
+        )
+
+        self.indexing_pressure = IndexingPressure(int(self.settings.raw(
+            "indexing_pressure.memory.limit", DEFAULT_LIMIT_BYTES)))
         from elasticsearch_tpu.common.settings import ClusterSettings, Setting
 
         # dynamic cluster settings registry (ref: ClusterSettings + the

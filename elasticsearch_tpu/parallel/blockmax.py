@@ -96,6 +96,8 @@ class _TermMeta:
 class BlockMaxBM25:
     """Serving-path executor for one text field over a (dp, shard) mesh."""
 
+    kind = "blockmax"
+
     def __init__(self, stacked: StackedBM25, mesh: Mesh):
         assert stacked.block_max_scores is not None, \
             "StackedBM25 built without block_max_scores"

@@ -181,98 +181,6 @@ def sweep_rowmax(qscale, cols_hi, cols_lo, wq, live, *, QC: int, nsw: int):
 ROWS_PER_STEP = 8
 
 
-def _resolve_kernel(QC: int, Hpt: int):
-    def kernel(qids, rowids, qscale,
-               *refs):
-        # refs: 8 hi row blocks, 8 lo row blocks, wq, out, (no scratch)
-        hi_rows = refs[:ROWS_PER_STEP]
-        lo_rows = refs[ROWS_PER_STEP:2 * ROWS_PER_STEP]
-        wq = refs[2 * ROWS_PER_STEP]
-        out = refs[2 * ROWS_PER_STEP + 1]
-        g = pl.program_id(0)
-        dn = (((1,), (0,)), ((), ()))
-        sub_iota = jax.lax.broadcasted_iota(
-            jnp.int32, (CHUNK_ROWS, 128), 0)
-        for h in range(ROWS_PER_STEP):
-            q = qids[g * ROWS_PER_STEP + h]
-            sub = rowids[g * ROWS_PER_STEP + h] % CHUNK_ROWS
-            wh = wq[0, pl.ds(q, 1), :]                    # [1, Hpt] i8
-            wl = wq[1, pl.ds(q, 1), :]
-            ch = hi_rows[h][0]                            # [Hpt, 16, 128]
-            cl = lo_rows[h][0]
-            m_hh = jax.lax.dot_general(wh, ch, dn,
-                                       preferred_element_type=jnp.int32)
-            m_hl = jax.lax.dot_general(wh, cl, dn,
-                                       preferred_element_type=jnp.int32)
-            m_lh = jax.lax.dot_general(wl, ch, dn,
-                                       preferred_element_type=jnp.int32)
-            m_ll = jax.lax.dot_general(wl, cl, dn,
-                                       preferred_element_type=jnp.int32)
-            val = (16384.0 * m_hh.astype(jnp.float32)
-                   + 128.0 * (m_hl + m_lh).astype(jnp.float32)
-                   + m_ll.astype(jnp.float32))            # [1, 16, 128]
-            # select the candidate row by mask-reduce (dynamic sublane
-            # indexing is not provably aligned; the extra 15 rows rode the
-            # same MXU pass for free)
-            row = jnp.sum(jnp.where(sub_iota == sub, val[0], 0.0), axis=0)
-            sc = qscale[pl.ds(q, 1), :]                   # [1, 1]
-            out[0, h, :] = row * sc[0]
-
-    return kernel
-
-
-@functools.partial(jax.jit, static_argnames=("n_steps",))
-def resolve_rows(qids, rowids, qscale, cols_hi, cols_lo, wq,
-                 *, n_steps: int):
-    """Pass 2: compute full approximate scores for selected posting rows.
-
-    qids   [n_steps * 8] i32 — owning query of each candidate row
-    rowids [n_steps * 8] i32 — global row ids (row * 128 = first doc)
-    qscale [QC, 1] f32; cols_* as in sweep_rowmax; wq [2, QC, Hpt] i8
-
-    Returns scores [n_steps, 8, 128] f32 (live masking and the >0 cut
-    happen on the host, which owns the live bitmap).
-    """
-    Hpt = cols_hi.shape[1]
-    QC = wq.shape[1]
-    kernel = _resolve_kernel(QC, Hpt)
-
-    def row_spec(h):
-        return pl.BlockSpec(
-            (1, Hpt, CHUNK_ROWS, 128),
-            lambda g, qids, rowids: (
-                rowids[g * ROWS_PER_STEP + h] // CHUNK_ROWS, 0, 0, 0),
-            memory_space=pltpu.VMEM)
-
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(n_steps,),
-        in_specs=(
-            [pl.BlockSpec((QC, 1), lambda g, *_: (0, 0),
-                          memory_space=pltpu.VMEM)]
-            + [row_spec(h) for h in range(ROWS_PER_STEP)]
-            + [row_spec(h) for h in range(ROWS_PER_STEP)]
-            + [pl.BlockSpec(memory_space=pltpu.VMEM)]
-        ),
-        out_specs=pl.BlockSpec((1, ROWS_PER_STEP, 128),
-                               lambda g, *_: (g, 0, 0),
-                               memory_space=pltpu.VMEM),
-        scratch_shapes=[],
-    )
-    fn = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_steps, ROWS_PER_STEP, 128),
-                                       jnp.float32),
-        compiler_params=pltpu.CompilerParams(
-            vmem_limit_bytes=100 * 1024 * 1024),
-        interpret=_interpret(),
-    )
-    args = ([qscale] + [cols_hi] * ROWS_PER_STEP + [cols_lo] * ROWS_PER_STEP
-            + [wq])
-    return fn(qids, rowids, *args)
-
-
 # --------------------------------------------------------------------------
 # column builder kernel
 # --------------------------------------------------------------------------

@@ -114,12 +114,17 @@ def build_stacked_bm25(
     live_masks: Sequence[np.ndarray] | None = None,
     mesh: Mesh | None = None,
     serve_only: bool = False,
+    device_arrays: bool = True,
 ) -> StackedBM25:
     """Stack per-shard single segments into shardable arrays.
 
     Each shard must be compacted to one segment (force_merge) — the stacked
     layout is the serving snapshot for the SPMD path, rebuilt on refresh the
     way the reference's searchable snapshot mounts a point-in-time commit.
+
+    device_arrays=False keeps block_docs/block_scores/live as host ndarrays
+    (TurboBM25 builds its own padded device copies; transferring the stacked
+    layout too would waste HBM and tunnel bandwidth).
     """
     fps = []
     for seg in segments:
@@ -168,7 +173,10 @@ def build_stacked_bm25(
     block_scores = np.where(block_tfs > 0, block_tfs * (K1 + 1.0) / denom, 0.0).astype(np.float32)
     block_max_scores = [block_scores[s].max(axis=1) for s in range(S)]
 
-    put = partial(_put_sharded, mesh=mesh)
+    if device_arrays:
+        put = partial(_put_sharded, mesh=mesh)
+    else:
+        put = lambda x: x  # noqa: E731 — host-resident stacked view
     return StackedBM25(
         field=field,
         block_docs=put(block_docs),

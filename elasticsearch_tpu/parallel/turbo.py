@@ -12,22 +12,24 @@ Per query the terms split three ways:
   the device cache (LRU over HBM budget, built on device by
   kernels.build_columns — no multi-GB host->device transfer). Scoring is
   one exact-integer matmul sweep producing per-superwindow top-NCAND
-  candidates.
+  candidate ROWS, globally re-ranked on device (_pick_rows) so only
+  ~n_rows row ids per query ever cross the host link.
 * **cold** (df < COLD_DF): at most a few thousand postings. The host
   computes EXACT totals for every cold-touched doc — it looks up the
   other query terms' impacts by binary search in the posting arrays — so
   any doc with a cold contribution is scored exactly with no device help.
-* the final top-k merges both sides: the host rescores the device's top
-  candidates in exact f32 (term-order identical to the reference scorer)
-  and checks a per-query CERTIFICATE that bounds what quantization could
-  hide:
+* the final top-k merges both sides: the host rescores EVERY doc in the
+  collected rows in exact f32 (term-order identical to the reference
+  scorer) and checks a per-query CERTIFICATE that bounds what the
+  quantized sweep could have hidden in rows it did NOT collect:
 
-      exact_kth >= max(approx_21st, max_sw sw_NCANDth) + e_q
+      exact_kth >= max(rowmax_{n_rows+1}, max_sw sw_NCANDth) + e_q
 
   where e_q is the int8 quantization error bound. Docs with cold lanes
-  are exact by construction; colized-only docs outside the candidate set
-  provably cannot beat the k-th result. If the certificate fails (rare),
-  the query falls back to the caller-provided exact path.
+  or collected rows are exact by construction; colized-only docs in
+  uncollected rows provably cannot beat the k-th result. If the
+  certificate fails (rare), the query falls back to the caller-provided
+  exact path.
 
 Ref: this replaces the reference's per-segment BulkScorer loop
 (ContextIndexSearcher.java:213-216) and its BlockMaxWAND pruning — the TPU
@@ -48,15 +50,53 @@ import numpy as np
 from elasticsearch_tpu.ops import bm25_idf
 from elasticsearch_tpu.parallel.blockmax import _host_block_scores
 from elasticsearch_tpu.parallel.kernels import (
-    CAND_PAD, COLSCALE, COLSCALE2, MAX_GROUP_ROWS, NCAND, ROWS_PER_STEP,
-    SW, TILE, build_columns, resolve_rows, sweep_rowmax,
+    COLSCALE, COLSCALE2, MAX_GROUP_ROWS, NCAND, ROWS_PER_STEP,
+    SW, TILE, build_columns, sweep_rowmax,
 )
 from elasticsearch_tpu.parallel.spmd import StackedBM25
 
 COLD_DF = 16384        # below this, terms are host-scored
-RESCORE = 20           # device candidates exactly rescored per query
 K1_PLUS1 = 2.2         # BM25 idf-free impact upper bound
-_GLOBAL_ROWS = 33      # candidate posting rows resolved per query
+_GLOBAL_ROWS = 33      # candidate posting rows collected per query
+
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.jit, static_argnames=("n_rows",))
+def _pick_rows(rm, rr, *, n_rows: int):
+    """Device-side global candidate-row pick (was a per-query host loop
+    over a ~10MB fetched array — the tunnel moves ~13MB/s): from the
+    sweep's per-superwindow top-NCAND (rowmax, row) pairs, keep each
+    query's global top n_rows rows.
+
+    Returns ONE packed [QC, n_rows + 1] f32 array — row ids as exact
+    floats (row < 2^24 always: 24-bit ordinal limit; -1 marks empty
+    slots — a bitcast sentinel would be a NaN pattern that transports
+    canonicalize) and, in the last column, the max approximate
+    score any UNCOLLECTED row could hold: the (n_rows+1)-th global rowmax
+    joined with each superwindow's NCAND-th kept rowmax (rows never
+    collected in a sw are bounded by it). The host rescores every doc in
+    the collected rows EXACTLY, so this bound is all the certificate
+    needs."""
+    QC = rm.shape[1]
+    m = jnp.transpose(rm[:, :, :NCAND], (1, 0, 2)).reshape(QC, -1)
+    r = jnp.transpose(rr[:, :, :NCAND], (1, 0, 2)).reshape(QC, -1)
+    if m.shape[1] < n_rows + 1:
+        pad = n_rows + 1 - m.shape[1]
+        m = jnp.pad(m, ((0, 0), (0, pad)), constant_values=-jnp.inf)
+        r = jnp.pad(r, ((0, 0), (0, pad)))
+    top_m, idx = jax.lax.top_k(m, n_rows + 1)
+    valid = top_m[:, :n_rows] > -jnp.inf
+    rows = jnp.where(valid,
+                     jnp.take_along_axis(r, idx[:, :n_rows], axis=1), -1)
+    beyond = top_m[:, n_rows]
+    beyond = jnp.where(jnp.isfinite(beyond), beyond, 0.0)
+    sw_last = rm[:, :, NCAND - 1]                          # [nsw, QC]
+    sw_bound = jnp.max(jnp.where(sw_last > -jnp.inf, sw_last, 0.0), axis=0)
+    return jnp.concatenate([
+        rows.astype(jnp.float32),
+        jnp.maximum(beyond, sw_bound)[:, None],
+    ], axis=1)
 _BUILD_BUCKETS = (256, 1024, 4096, 16384, 32768)   # last one bounded by
 #   SMEM: 4 prefetch arrays x bucket x 4B must stay well under the 1MB SMEM
 
@@ -90,23 +130,42 @@ class TurboBM25:
     def __init__(self, stacked: StackedBM25, *,
                  hbm_budget_bytes: int = 10 << 30,
                  qc_sizes: Tuple[int, ...] = (8, 256),
-                 fallback: Optional[Callable] = None):
+                 cold_df: int = COLD_DF,
+                 fallback: Optional[Callable] = None,
+                 total_docs: Optional[int] = None,
+                 avgdl: Optional[float] = None,
+                 df_of: Optional[Callable[[str], int]] = None):
+        """total_docs / avgdl / df_of override the single-partition stacked
+        stats with INDEX-GLOBAL values when this engine serves one partition
+        of a multi-segment index (serving.TurboEngine) — scoring must use
+        the same global idf/avgdl on every partition (the reference's
+        dfs_query_then_fetch semantics, serving.py module docstring)."""
         assert stacked.n_shards == 1, "TurboBM25 v1 serves one partition"
         self.stacked = stacked
         self.fp = stacked.postings[0]
         self.fallback = fallback
+        self.cold_df = int(cold_df)
+        self._total_docs = int(total_docs) if total_docs else stacked.total_docs
+        self._avgdl = float(avgdl) if avgdl else stacked.avgdl
+        self._df_of = df_of
         self.D = stacked.doc_counts[0]
         self.Dp = -(-self.D // SW) * SW
         self.nsw = self.Dp // SW
         self.dp_rows = self.Dp // 128
-        self.qc_sizes = tuple(sorted(qc_sizes))
+        # dispatch widths: rounded up to ROWS_PER_STEP multiples so the
+        # sweep kernel block shapes stay sublane-aligned
+        # (ADVICE r4), deduped, ascending
+        self.qc_sizes = tuple(sorted(
+            {max(ROWS_PER_STEP,
+                 -(-int(s) // ROWS_PER_STEP) * ROWS_PER_STEP)
+             for s in qc_sizes}))
 
         fp = self.fp
         # lane arrays with trailing DMA padding rows
         pad = np.zeros((MAX_GROUP_ROWS, 128), np.int32)
         self.lane_docs = jnp.asarray(
             np.concatenate([fp.block_docs, pad], axis=0))
-        bs = _host_block_scores(fp, stacked.avgdl)
+        bs = _host_block_scores(fp, self._avgdl)
         self.lane_scores = jnp.asarray(
             np.concatenate([bs, pad.astype(np.float32)], axis=0))
         self._host_scores = bs       # [T, 128] idf-free lane scores
@@ -128,7 +187,7 @@ class TurboBM25:
         # column cache sizing: slots + 1 scratch slot for padding groups
         # (2 bytes per doc per slot: hi + lo residual layers)
         slots = max(int(hbm_budget_bytes // (2 * self.Dp)), 32)
-        n_colizable = int((fp.doc_freq >= COLD_DF).sum())
+        n_colizable = int((fp.doc_freq >= self.cold_df).sum())
         slots = min(slots, max(n_colizable, 1) + 8)
         self.Hp = ((slots + 31) // 32) * 32
         dp_chunks = self.dp_rows // 16
@@ -141,7 +200,7 @@ class TurboBM25:
         self._tick = 0
         self._terms: Dict[str, Optional[_TermInfo]] = {}
         self.stats = {"builds": 0, "build_s": 0.0, "fallbacks": 0,
-                      "cold_queries": 0, "dispatches": 0}
+                      "cold_queries": 0, "dispatches": 0, "degraded": 0}
 
     # ---------------- term metadata ----------------
 
@@ -156,8 +215,11 @@ class TurboBM25:
         df = int(fp.doc_freq[o])
         start, cnt = int(fp.block_start[o]), int(fp.block_count[o])
         smax = float(self._host_scores[start: start + cnt].max()) if cnt else 0.0
+        # df for cache/cold decisions is partition-LOCAL (it sizes local
+        # work); idf uses the global df when an override is installed
+        df_g = self._df_of(term) if self._df_of is not None else df
         info = _TermInfo(ord=o, df=df,
-                         idf=bm25_idf(self.stacked.total_docs, df),
+                         idf=bm25_idf(self._total_docs, df_g),
                          row_start=start, n_rows=cnt, smax=smax)
         self._terms[term] = info
         return info
@@ -185,7 +247,7 @@ class TurboBM25:
         need: List[_TermInfo] = []
         for t in dict.fromkeys(terms):
             info = self._term(t)
-            if info is None or info.df < COLD_DF:
+            if info is None or info.df < self.cold_df:
                 continue
             if t in self._slot_of:
                 self._lru[t] = self._tick
@@ -199,8 +261,14 @@ class TurboBM25:
             victims = [t for t in sorted(self._lru, key=self._lru.get)
                        if t not in protect][:deficit]
             if len(victims) < deficit:
-                raise ValueError(
-                    f"batch needs {len(need)} columns > capacity {self.Hp}")
+                # capacity overflow: colize the highest-df terms (where a
+                # missing column hurts most) and leave the rest cold for
+                # this batch — the host scores them exactly (ADVICE r4:
+                # this used to raise ValueError on the serving path)
+                capacity = len(self._free) + len(victims)
+                need.sort(key=lambda ti: -ti[1].df)
+                self.stats["degraded"] += len(need) - capacity
+                need = need[:capacity]
             for v in victims:
                 slot = self._slot_of.pop(v)
                 del self._lru[v]
@@ -216,6 +284,10 @@ class TurboBM25:
         for r, n, b, s in self._pending_zero:
             rows_l.append(r); n_l.append(n); base_l.append(b); slot_l.append(s)
         self._pending_zero = []
+        if not need and not rows_l:
+            # full degradation (every slot protected, nothing evictable,
+            # no zeroing pending): nothing to dispatch
+            return
         for t, info in need:
             slot = self._free.pop()
             self._slot_of[t] = slot
@@ -243,6 +315,38 @@ class TurboBM25:
                 self.cols_hi, self.cols_lo, n_groups=ng)
         self.stats["builds"] += len(need)
         self.stats["build_s"] += time.monotonic() - t0
+
+    def _cold_contrib(self, cold_terms):
+        """(docs i64 unique-sorted, contrib f64) — the cold terms' summed
+        contributions at their own postings, read straight off each term's
+        lane scores (no cross-term binary searches)."""
+        fp = self.fp
+        arrs, vals = [], []
+        for _, b, info in cold_terms:
+            lo, hi = (int(fp.post_start[info.ord]),
+                      int(fp.post_start[info.ord + 1]))
+            arrs.append(np.asarray(fp.post_doc[lo:hi], np.int64))
+            lanes = self._host_scores[
+                info.row_start: info.row_start + info.n_rows
+            ].ravel()[: hi - lo]
+            vals.append(float(info.idf * b) * lanes.astype(np.float64))
+        docs = np.concatenate(arrs)
+        u, inv = np.unique(docs, return_inverse=True)
+        acc = np.zeros(len(u), np.float64)
+        np.add.at(acc, inv, np.concatenate(vals))
+        return u, acc
+
+    def prebuild_columns(self) -> int:
+        """Build every colizable term's column now (capacity-capped, by
+        df desc). Serving warms lazily; benchmarks and latency-sensitive
+        deployments call this so no timed query ever pays a build."""
+        fp = self.fp
+        terms = [fp.terms[o] for o in
+                 np.nonzero(np.asarray(fp.doc_freq) >= self.cold_df)[0]]
+        terms.sort(key=lambda t: -int(fp.doc_freq[fp.term_to_ord[t]]))
+        terms = terms[: self.Hp]       # capacity-capped: never churn
+        self.ensure_columns(terms)
+        return len(terms)
 
     # ---------------- host exact scoring helpers ----------------
 
@@ -296,10 +400,11 @@ class TurboBM25:
 
     # ---------------- search ----------------
 
-    def search_many(self, batches: Sequence[List], k: int = 10):
+    def search_many(self, batches: Sequence[List], k: int = 10, check=None):
         """Pipeline batches of queries; returns per batch
         (scores [Q, k] f32, ords [Q, k] i32). Queries are term lists or
-        (term, boost) lists."""
+        (term, boost) lists. check: optional cooperative-cancellation
+        callable invoked between dispatches (tasks/task_manager)."""
         flat: List[List[Tuple[str, float]]] = []
         spans = []
         for queries in batches:
@@ -315,58 +420,51 @@ class TurboBM25:
                     for _, n in spans]
         self.ensure_columns(
             [t for q in flat for t, _ in q
-             if (i := self._term(t)) is not None and i.df >= COLD_DF])
+             if (i := self._term(t)) is not None and i.df >= self.cold_df])
 
-        # pass 1: sweep dispatches (async)
+        # pass 1: sweep -> row pick, both on device, dispatched async per
+        # chunk; only the packed [QC, n_rows+1] pick output crosses the
+        # link (the tunnel moves ~13 MB/s, so fetching the
+        # [nsw, QC, CAND_PAD] sweep output like the r4 version did costs
+        # ~1s per batch)
+        n_rows = max(_GLOBAL_ROWS, k + 5)
         pending = []
         off = 0
         while off < len(flat):
-            take = self.qc_sizes[-1]
-            if len(flat) - off <= self.qc_sizes[0]:
-                take = self.qc_sizes[0]
+            rem = len(flat) - off
+            # smallest compiled width that covers the remainder (ADVICE r4:
+            # intermediate qc_sizes used to be dead)
+            take = next((s for s in self.qc_sizes if s >= rem),
+                        self.qc_sizes[-1])
             chunk = flat[off: off + take]
-            wq, qscale, sweep = self._sweep(chunk, take)
-            pending.append((off, len(chunk), take, wq, qscale, sweep))
+            if check is not None:
+                check()
+            wq, qscale, (rm, rr) = self._sweep(chunk, take)
+            pending.append((off, len(chunk),
+                            _pick_rows(rm, rr, n_rows=n_rows)))
             off += len(chunk)
         self.stats["dispatches"] += len(pending)
 
-        # pass 2: pick global candidate rows per query, resolve on device
+        # pass 2: fetch the tiny row sets; EXACT host rescore of every doc
+        # in the collected rows (33 rows x 128 lanes x a binary search per
+        # query term — ~1ms/query), merged with the cold side
+        lane = np.arange(128, dtype=np.int64)
         out_s = np.zeros((len(flat), k), np.float32)
         out_d = np.zeros((len(flat), k), np.int32)
-        n_rows = max(_GLOBAL_ROWS, k + 5)
-        for off, n, QC, wq, qscale, (rm_dev, rr_dev) in pending:
-            rm = np.asarray(rm_dev)    # [nsw, QC, CAND_PAD]
-            rr = np.asarray(rr_dev)
-            qids = np.zeros(QC * n_rows, np.int32)
-            rowids = np.zeros(QC * n_rows, np.int32)
-            picks = []                 # per query: (rows, bound_beyond)
+        for off, n, packed_dev in pending:
+            if check is not None:
+                check()
+            packed = np.asarray(packed_dev)        # [QC, n_rows + 1]
+            rows_all = packed[:, :n_rows].astype(np.int64)
+            bounds = packed[:, n_rows]
             for qi in range(n):
-                m = rm[:, qi, :NCAND].ravel()
-                r = rr[:, qi, :NCAND].ravel()
-                valid = m > -np.inf
-                m, r = m[valid], r[valid]
-                order = np.lexsort((r, -m))
-                top = order[:n_rows]
-                beyond = float(m[order[n_rows]]) if len(order) > n_rows \
-                    else 0.0
-                # rows NOT collected in any sw are bounded by that sw's
-                # NCAND-th kept rowmax
-                sw_last = np.where(rm[:, qi, NCAND - 1] > -np.inf,
-                                   rm[:, qi, NCAND - 1], 0.0)
-                sw_bound = float(sw_last.max()) if len(sw_last) else 0.0
-                picks.append((r[top], max(beyond, sw_bound)))
-                qids[qi * n_rows: qi * n_rows + len(top)] = qi
-                rowids[qi * n_rows: qi * n_rows + len(top)] = r[top]
-            n_steps = -(-(QC * n_rows) // ROWS_PER_STEP)
-            scores = np.asarray(resolve_rows(
-                jnp.asarray(qids), jnp.asarray(rowids), qscale,
-                self.cols_hi, self.cols_lo, wq,
-                n_steps=n_steps)).reshape(-1, 128)
-            for qi in range(n):
-                rows_q, bound_beyond = picks[qi]
-                sc = scores[qi * n_rows: qi * n_rows + len(rows_q)]
+                rw = rows_all[qi]
+                rw = rw[rw >= 0]
+                docs = (rw[:, None] * 128 + lane[None, :]).ravel()
+                if len(docs):
+                    docs = docs[self._live_host[docs] > 0]
                 s, d = self._finish_query(
-                    flat[off + qi], rows_q, sc, bound_beyond, k)
+                    flat[off + qi], docs, float(bounds[qi]), k)
                 out_s[off + qi, : len(s)] = s
                 out_d[off + qi, : len(d)] = d
         return [(out_s[o: o + n], out_d[o: o + n]) for o, n in spans]
@@ -380,9 +478,9 @@ class TurboBM25:
         for qi, terms in enumerate(chunk):
             ws = []
             for t, b in terms:
-                info = self._term(t)
-                if info is not None and info.df >= COLD_DF:
-                    ws.append((self._slot_of[t], info.idf * b))
+                slot = self._slot_of.get(t)
+                if slot is not None:
+                    ws.append((slot, self._term(t).idf * b))
             if not ws:
                 continue
             wmax = max(abs(w) for _, w in ws)
@@ -394,19 +492,18 @@ class TurboBM25:
                 wl = max(-127, min(127, round((w - qs * wh) / qs2)))
                 wq[0, qi, slot] = np.int8(wh)
                 wq[1, qi, slot] = np.int8(wl)
-        wq_dev = jnp.asarray(wq)
-        qscale_dev = jnp.asarray(qscale)
-        out = sweep_rowmax(qscale_dev, self.cols_hi, self.cols_lo,
-                           wq_dev, self.live, QC=QC, nsw=self.nsw)
-        return wq_dev, qscale_dev, out
+        out = sweep_rowmax(jnp.asarray(qscale), self.cols_hi, self.cols_lo,
+                           jnp.asarray(wq), self.live, QC=QC, nsw=self.nsw)
+        return wq, qscale, out
 
-    def _finish_query(self, terms, rows_q, row_scores, bound_beyond, k):
-        """Merge device row candidates + host cold side into exact top-k.
+    def _finish_query(self, terms, cand_docs, bound, k):
+        """Merge device-collected candidates + host cold side into exact
+        top-k.
 
-        rows_q [R] global row ids; row_scores [R, 128] approximate scores
-        of those rows' docs (live/positivity not yet applied);
-        bound_beyond — max approximate score any UNRESOLVED row could
-        hold (the global cut + per-superwindow collection bounds)."""
+        cand_docs [C] live doc ids from the collected rows — every one is
+        rescored EXACTLY here, so quantization error only matters for
+        UNCOLLECTED rows; bound — the max approximate score any of those
+        could hold (device pick output)."""
         qterms = []
         cold_terms = []
         col_terms = []
@@ -415,7 +512,10 @@ class TurboBM25:
             if info is None:
                 continue
             qterms.append((t, b, info))
-            (cold_terms if info.df < COLD_DF else col_terms).append(
+            # colized = owns a column NOW (a term past cold_df may have been
+            # left cold by capacity degradation); the split must mirror what
+            # _sweep dispatched so the certificate stays sound
+            (col_terms if t in self._slot_of else cold_terms).append(
                 (t, b, info))
 
         if not qterms:
@@ -439,63 +539,68 @@ class TurboBM25:
             e_q += 3e-7 * sum(abs(w) for w in ws) * K1_PLUS1
         e_q = float(e_q)
 
-        # ---- cold side: exact totals for every cold-touched live doc ----
-        cold_docs = []
-        for t, b, info in cold_terms:
-            fp = self.fp
-            lo, hi = (int(fp.post_start[info.ord]),
-                      int(fp.post_start[info.ord + 1]))
-            cold_docs.append(fp.post_doc[lo:hi])
-        exact_pool: Dict[int, float] = {}
+        # ---- candidate docs from collected rows: exact rescore first ----
+        cand_s = np.empty(0, np.float32)
+        if len(cand_docs):
+            cand_docs = np.asarray(cand_docs, np.int64)
+            cand_s = self._exact_scores(qterms, cand_docs)
+            keep = cand_s > 0
+            cand_docs, cand_s = cand_docs[keep], cand_s[keep]
+
+        # ---- cold side, bound-pruned (the 10M-doc bottleneck was exact-
+        # scoring EVERY cold-touched doc — up to 2 x cold_df of them — with
+        # binary searches into multi-million-entry colized posting lists;
+        # a doc whose cold contribution plus the colized terms' maximum
+        # possible addend cannot reach the candidate k-th score needs no
+        # lookup at all) ----
+        cold_docs = np.empty(0, np.int64)
+        cold_s = np.empty(0, np.float32)
         if cold_terms:
             self.stats["cold_queries"] += 1
-            docs = np.unique(np.concatenate(cold_docs))
-            docs = docs[self._live_host[docs] > 0]
-            if len(docs):
-                totals = self._exact_scores(qterms, docs)
-                pos = totals > 0
-                for d, s in zip(docs[pos], totals[pos]):
-                    exact_pool[int(d)] = float(s)
+            docs_c, contrib = self._cold_contrib(cold_terms)
+            lv = self._live_host[docs_c] > 0
+            docs_c, contrib = docs_c[lv], contrib[lv]
+            if col_terms:
+                kth_0 = 0.0
+                if len(cand_s) >= k:
+                    kth_0 = float(np.partition(cand_s, len(cand_s) - k)[
+                        len(cand_s) - k])
+                col_const = sum(info.idf * b * info.smax
+                                for _, b, info in col_terms)
+                # float64 contrib + margin keeps this a true upper bound
+                survivors = docs_c[contrib + col_const + 1e-5 >= kth_0]
+                if len(survivors):
+                    cold_docs = survivors
+                    cold_s = self._exact_scores(qterms, cold_docs)
+            else:
+                # cold-only query: the exact path IS the full merge
+                cold_docs = docs_c
+                cold_s = self._exact_scores(qterms, cold_docs)
 
-        # ---- device side: resolved candidate rows, rescore the top ----
-        if col_terms and len(rows_q):
-            docs_all = (rows_q.astype(np.int64)[:, None] * 128
-                        + np.arange(128, dtype=np.int64)[None, :]).ravel()
-            sc_all = row_scores[: len(rows_q)].ravel()
-            ok = (sc_all > 0) & (self._live_host[docs_all] > 0)
-            fd, fs = docs_all[ok], sc_all[ok]
-            order = np.lexsort((fd, -fs))
-            n_rescore = max(RESCORE, k + 5)
-            top = order[: n_rescore + 1]
-            approx_next = float(fs[top[n_rescore]]) if len(top) > n_rescore \
-                else 0.0
-            approx_next = max(approx_next, float(bound_beyond))
-            rescore_d = fd[top[: n_rescore]]
-            if len(rescore_d):
-                ex = self._exact_scores(qterms, rescore_d)
-                for d, s in zip(rescore_d, ex):
-                    if s > 0 and int(d) not in exact_pool:
-                        exact_pool[int(d)] = float(s)
-        else:
-            approx_next = float(bound_beyond) if col_terms else 0.0
-
-        if not exact_pool:
+        if not len(cand_docs) and not len(cold_docs):
             return np.empty(0, np.float32), np.empty(0, np.int32)
-        docs = np.fromiter(exact_pool.keys(), np.int64, len(exact_pool))
-        scores = np.fromiter(exact_pool.values(), np.float64,
-                             len(exact_pool)).astype(np.float32)
-        sel = np.lexsort((docs, -scores))[:k]
-        out_s, out_d = scores[sel], docs[sel].astype(np.int32)
+        docs = np.concatenate([cand_docs, cold_docs])
+        totals = np.concatenate([cand_s, cold_s])
+        # dedupe (both sides are exact and identical for shared docs)
+        docs, first = np.unique(docs, return_index=True)
+        totals = totals[first]
+        pos = totals > 0
+        docs, totals = docs[pos], totals[pos]
+        if not len(docs):
+            return np.empty(0, np.float32), np.empty(0, np.int32)
+        sel = np.lexsort((docs, -totals))[:k]
+        out_s, out_d = totals[sel], docs[sel].astype(np.int32)
 
         # ---- certificate ----
         if col_terms:
-            # docs outside the exact pool are bounded by the best score the
-            # device could have under-reported plus the quantization error
-            uncollected = approx_next
-            bound = uncollected + e_q
+            # every collected doc is EXACT; a doc outside the pool sits in
+            # an uncollected row, whose approximate rowmax bound plus the
+            # quantization error bounds its true score
+            uncollected = float(bound)
+            limit = uncollected + e_q
             kth = float(out_s[k - 1]) if len(out_s) >= k else 0.0
             short = len(out_s) < k and uncollected > 0
-            if short or (len(out_s) >= k and kth < bound and uncollected > 0):
+            if short or (len(out_s) >= k and kth < limit and uncollected > 0):
                 self.stats["fallbacks"] += 1
                 if self.fallback is not None:
                     return self.fallback(terms, k)

@@ -518,7 +518,14 @@ class _Handlers:
     # ---------- bulk ----------
 
     def bulk(self, req: RestRequest) -> RestResponse:
-        """NDJSON bulk (ref: action/bulk/TransportBulkAction.java:164)."""
+        """NDJSON bulk (ref: action/bulk/TransportBulkAction.java:164).
+        The whole request's bytes are reserved on the node's
+        IndexingPressure for the bulk's lifetime — a flood bounces with
+        429 instead of buffering unbounded (ref: IndexingPressure.java)."""
+        with self.node.indexing_pressure.coordinating(len(req.raw_body)):
+            return self._bulk_inner(req)
+
+    def _bulk_inner(self, req: RestRequest) -> RestResponse:
         default_index = req.param("index")
         lines = [ln for ln in req.raw_body.decode("utf-8").split("\n") if ln.strip()]
         items: List[dict] = []
@@ -1512,6 +1519,7 @@ class _Handlers:
                 "indices": {"docs": {"count": sum(
                     self.node.indices.get(n).doc_count() for n in self.node.indices.names())}},
                 "breakers": self.node.breakers.stats(),
+                "indexing_pressure": self.node.indexing_pressure.stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
         })

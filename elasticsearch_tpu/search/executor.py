@@ -290,6 +290,165 @@ class QueryExecutor:
     def _exec_TermQuery(self, query, leaf):
         return self._term_scores(leaf, query.field, str(query.value))
 
+    # ---- parent-join (ref: modules/parent-join; VERDICT r4 item 6) ----
+    # Joins are shard-scoped (parent and child share a shard via routing,
+    # the reference's constraint), so the inner query runs once over ALL
+    # of the shard's leaves and the per-parent aggregate is cached on the
+    # query instance — each shard parses its own query tree, so the cache
+    # is naturally shard-local.
+
+    def _shard_leaves(self):
+        out = []
+        base = 0
+        for v in self.stats.views:
+            out.append(LeafContext(v, base))
+            base += v.segment.n_docs
+        return out
+
+    def _join_children_agg(self, query, child_type: str):
+        """parent_id -> (count, sum, max, min) over live matching childs."""
+        state = getattr(query, "_join_state", None)
+        if state is not None:
+            return state
+        jf = self.mapper.join_field()
+        agg: dict = {}
+        if jf is not None:
+            for lf in self._shard_leaves():
+                seg = lf.segment
+                names = seg.keyword.get(jf.name)
+                parents = seg.keyword.get(f"{jf.name}.__parent")
+                if names is None or parents is None:
+                    continue
+                child_ord = names.term_to_ord.get(child_type)
+                if child_ord is None:
+                    continue
+                s, m = self.execute(query.query, lf)
+                m = np.asarray(m) & lf.view.live & (names.ords == child_ord)
+                s = np.asarray(s)
+                for o in np.nonzero(m)[0]:
+                    pts = parents.doc_terms(int(o))
+                    if not pts:
+                        continue
+                    pid = pts[0]
+                    sc = float(s[o])
+                    cur = agg.get(pid)
+                    agg[pid] = (1, sc, sc, sc) if cur is None else (
+                        cur[0] + 1, cur[1] + sc, max(cur[2], sc),
+                        min(cur[3], sc))
+        query._join_state = agg
+        return agg
+
+    def _exec_HasChildQuery(self, query, leaf):
+        jf = self.mapper.join_field()
+        n = leaf.n_docs
+        if jf is None:
+            return jnp.zeros(n, jnp.float32), jnp.zeros(n, bool)
+        parent_type = jf.parent_of.get(query.type)
+        agg = self._join_children_agg(query, query.type)
+        names = leaf.segment.keyword.get(jf.name)
+        mask = np.zeros(n, bool)
+        scores = np.zeros(n, np.float32)
+        if names is not None and parent_type is not None:
+            p_ord = names.term_to_ord.get(parent_type)
+            if p_ord is not None:
+                is_parent = names.ords == p_ord
+                for o in np.nonzero(is_parent)[0]:
+                    st = agg.get(leaf.segment.doc_ids[int(o)])
+                    if st is None or not (query.min_children <= st[0]
+                                          <= query.max_children):
+                        continue
+                    mask[o] = True
+                    mode = query.score_mode
+                    val = {"none": 1.0, "sum": st[1], "max": st[2],
+                           "min": st[3], "avg": st[1] / st[0]}.get(mode, 1.0)
+                    scores[o] = query.boost * val
+        return jnp.asarray(scores), jnp.asarray(mask)
+
+    def _exec_HasParentQuery(self, query, leaf):
+        jf = self.mapper.join_field()
+        n = leaf.n_docs
+        if jf is None:
+            return jnp.zeros(n, jnp.float32), jnp.zeros(n, bool)
+        state = getattr(query, "_join_state", None)
+        if state is None:
+            # matching LIVE parents: id -> score
+            state = {}
+            for lf in self._shard_leaves():
+                seg = lf.segment
+                names = seg.keyword.get(jf.name)
+                if names is None:
+                    continue
+                p_ord = names.term_to_ord.get(query.parent_type)
+                if p_ord is None:
+                    continue
+                s, m = self.execute(query.query, lf)
+                m = np.asarray(m) & lf.view.live & (names.ords == p_ord)
+                s = np.asarray(s)
+                for o in np.nonzero(m)[0]:
+                    state[seg.doc_ids[int(o)]] = float(s[o])
+            query._join_state = state
+        names = leaf.segment.keyword.get(jf.name)
+        parents = leaf.segment.keyword.get(f"{jf.name}.__parent")
+        mask = np.zeros(n, bool)
+        scores = np.zeros(n, np.float32)
+        if names is not None and parents is not None:
+            child_types = {c for c, p in jf.parent_of.items()
+                           if p == query.parent_type}
+            child_ords = {names.term_to_ord[c] for c in child_types
+                          if c in names.term_to_ord}
+            if child_ords:
+                is_child = np.isin(names.ords, list(child_ords))
+                for o in np.nonzero(is_child)[0]:
+                    pts = parents.doc_terms(int(o))
+                    if pts and pts[0] in state:
+                        mask[o] = True
+                        scores[o] = query.boost * (
+                            state[pts[0]] if query.score else 1.0)
+        return jnp.asarray(scores), jnp.asarray(mask)
+
+    def _exec_ParentIdQuery(self, query, leaf):
+        jf = self.mapper.join_field()
+        n = leaf.n_docs
+        if jf is None:
+            return jnp.zeros(n, jnp.float32), jnp.zeros(n, bool)
+        names = leaf.segment.keyword.get(jf.name)
+        parents = leaf.segment.keyword.get(f"{jf.name}.__parent")
+        mask = np.zeros(n, bool)
+        if names is not None and parents is not None:
+            c_ord = names.term_to_ord.get(query.type)
+            if c_ord is not None:
+                for o in np.nonzero(names.ords == c_ord)[0]:
+                    pts = parents.doc_terms(int(o))
+                    if pts and pts[0] == query.id:
+                        mask[o] = True
+        scores = np.where(mask, np.float32(query.boost), 0.0)
+        return jnp.asarray(scores.astype(np.float32)), jnp.asarray(mask)
+
+    def _exec_PercolateQuery(self, query, leaf):
+        """Reverse search (ref: modules/percolator/PercolateQuery.java):
+        candidates via the hidden `<field>.__terms` sidecar postings, then
+        exact replay of each candidate's stored query against an in-memory
+        segment of the percolated document(s). Constant score (the
+        reference's non-scoring percolation mode)."""
+        from elasticsearch_tpu.search.percolate import (
+            build_memory_views, document_tokens, matching_ords,
+        )
+
+        state = getattr(query, "_mem_state", None)
+        if state is None:
+            views = build_memory_views(self.mapper, query.documents)
+            state = (views, document_tokens(views))
+            query._mem_state = state    # reuse across this request's leaves
+        mem_views, doc_toks = state
+        ords = matching_ords(leaf.segment, query.field, doc_toks,
+                             self.mapper, mem_views, check=self.check)
+        n = leaf.n_docs
+        mask = np.zeros(n, bool)
+        if len(ords):
+            mask[ords] = True
+        scores = np.where(mask, np.float32(query.boost), 0.0)
+        return jnp.asarray(scores.astype(np.float32)), jnp.asarray(mask)
+
     def _impl_TermsQuery(self, query, leaf):
         """Constant-score disjunction (ref: Lucene TermInSetQuery)."""
         field = query.field

@@ -215,6 +215,49 @@ class NestedQuery(Query):
 
 
 @dataclass
+class HasChildQuery(Query):
+    """ref: modules/parent-join/HasChildQueryBuilder.java — parents with at
+    least min_children matching children; score_mode none (default), sum,
+    max, min, avg."""
+
+    type: str
+    query: Query = None
+    score_mode: str = "none"
+    min_children: int = 1
+    max_children: int = 2**31 - 1
+    boost: float = 1.0
+
+
+@dataclass
+class HasParentQuery(Query):
+    """ref: modules/parent-join/HasParentQueryBuilder.java."""
+
+    parent_type: str
+    query: Query = None
+    score: bool = False
+    boost: float = 1.0
+
+
+@dataclass
+class ParentIdQuery(Query):
+    """ref: modules/parent-join/ParentIdQueryBuilder.java."""
+
+    type: str
+    id: str = ""
+    boost: float = 1.0
+
+
+@dataclass
+class PercolateQuery(Query):
+    """ref: modules/percolator/PercolateQueryBuilder.java — match stored
+    queries in `field` against the given document(s)."""
+
+    field: str
+    documents: List[dict] = field(default_factory=list)
+    boost: float = 1.0
+
+
+@dataclass
 class KnnQuery(Query):
     """Top-level knn search section (ES 8 _search "knn" or query vector)."""
 
@@ -371,6 +414,36 @@ def parse_query(body: dict) -> Query:
                            score_mode=spec.get("score_mode", "avg"),
                            inner_hits=spec.get("inner_hits"),
                            boost=spec.get("boost", 1.0))
+
+    if kind == "has_child":
+        return HasChildQuery(type=spec["type"],
+                             query=parse_query(spec["query"]),
+                             score_mode=spec.get("score_mode", "none"),
+                             min_children=int(spec.get("min_children", 1)),
+                             max_children=int(spec.get("max_children",
+                                                       2**31 - 1)),
+                             boost=spec.get("boost", 1.0))
+
+    if kind == "has_parent":
+        return HasParentQuery(parent_type=spec["parent_type"],
+                              query=parse_query(spec["query"]),
+                              score=bool(spec.get("score", False)),
+                              boost=spec.get("boost", 1.0))
+
+    if kind == "parent_id":
+        return ParentIdQuery(type=spec["type"], id=str(spec["id"]),
+                             boost=spec.get("boost", 1.0))
+
+    if kind == "percolate":
+        docs = spec.get("documents")
+        if docs is None:
+            doc = spec.get("document")
+            if doc is None:
+                raise ParsingError(
+                    "[percolate] requires [document] or [documents]")
+            docs = [doc]
+        return PercolateQuery(field=spec["field"], documents=list(docs),
+                              boost=spec.get("boost", 1.0))
 
     if kind == "fuzzy":
         fname, v = _one_entry(spec, "fuzzy")

@@ -45,6 +45,13 @@ class SnapshotsService:
         location = settings.get("location")
         if not location:
             raise RepositoryError("missing location")
+        # re-registering the same name+location must keep the existing
+        # instance: replacing it would discard the mutation_lock any
+        # in-flight create/restore holds, letting a delete via the new
+        # instance GC blobs of an in-flight snapshot (ADVICE r4)
+        existing = self.repositories.get(name)
+        if existing is not None and existing.location == location:
+            return
         self.repositories[name] = FsRepository(name, location)
 
     def repository(self, name: str) -> FsRepository:

@@ -1,0 +1,168 @@
+"""Bounded coordinator reduce + indexing backpressure (VERDICT r4 item 8;
+ref: action/search/QueryPhaseResultConsumer.java:52,
+index/IndexingPressure.java:1) and the data-only agg wire codec
+(ADVICE r4)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common.indexing_pressure import (
+    EsRejectedExecutionError, IndexingPressure,
+)
+from elasticsearch_tpu.common.wire import decode_value, encode_value
+
+
+# ------------------------------------------------------------- wire ----
+
+
+def test_wire_roundtrip_nested():
+    val = {
+        "sum": np.float64(3.5),
+        "arr": np.arange(12, dtype=np.int32).reshape(3, 4),
+        "buckets": [{"key": ("a", 1), "docs": 5}, {"key": ("b", 2),
+                    "docs": 7}],
+        "keys": {("composite", 3): [1.0, float("inf"), float("nan")]},
+        "flags": {True, 1, "x"} and {"x", "y"},
+        "none": None,
+        "raw": b"\x00\x01",
+    }
+    out = decode_value(encode_value(val))
+    assert out["sum"] == 3.5 and isinstance(out["sum"], np.float64)
+    assert np.array_equal(out["arr"], val["arr"])
+    assert out["buckets"][0]["key"] == ("a", 1)
+    k = ("composite", 3)
+    assert out["keys"][k][1] == float("inf")
+    assert out["keys"][k][2] != out["keys"][k][2]      # nan
+    assert out["raw"] == b"\x00\x01"
+
+
+def test_wire_rejects_code_bearing_types():
+    import pytest as _pytest
+
+    from elasticsearch_tpu.common.wire import WireError
+
+    with _pytest.raises(WireError):
+        encode_value(lambda: 1)
+    with _pytest.raises(WireError):
+        encode_value(object())
+
+
+def test_wire_is_json_safe():
+    import json
+
+    enc = encode_value({"a": np.ones(3), "b": [(1, 2)]})
+    json.loads(json.dumps(enc))     # must survive a JSON transport hop
+
+
+# -------------------------------------------------- indexing pressure ----
+
+
+def test_indexing_pressure_rejects_over_limit():
+    ip = IndexingPressure(limit_bytes=1000)
+    with ip.coordinating(800):
+        with pytest.raises(EsRejectedExecutionError):
+            with ip.coordinating(300):
+                pass
+        # released reservations recover capacity
+    with ip.coordinating(900):
+        pass
+    st = ip.stats()["memory"]
+    assert st["total"]["coordinating_rejections"] == 1
+    assert st["current"]["all_in_bytes"] == 0
+
+
+def test_indexing_pressure_replica_headroom():
+    ip = IndexingPressure(limit_bytes=1000)
+    with ip.coordinating(900):
+        # replica ops ride the 1.5x limit so replication can't deadlock
+        with ip.replica(400):
+            pass
+        with pytest.raises(EsRejectedExecutionError):
+            with ip.replica(700):
+                pass
+
+
+def test_rest_bulk_flood_gets_429():
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import RestController, register_handlers
+
+    node = Node(settings=Settings(
+        {"indexing_pressure.memory.limit": 2048}))
+    rc = RestController()
+    register_handlers(node, rc)
+    try:
+        node.create_index("bp", {})
+        small = '{"index":{"_index":"bp","_id":"1"}}\n{"f":"v"}\n'
+        r = rc.dispatch("POST", "/_bulk", {}, small)
+        assert r.status == 200, r.body
+        big = ('{"index":{"_index":"bp","_id":"2"}}\n{"f":"'
+               + "x" * 4096 + '"}\n')
+        r = rc.dispatch("POST", "/_bulk", {}, big)
+        assert r.status == 429, r.body
+        assert "es_rejected_execution_exception" in str(r.body)
+        # capacity recovers once the rejected request unwinds
+        r = rc.dispatch("POST", "/_bulk", {}, small)
+        assert r.status == 200
+        # and the rejection is visible in node stats
+        st = rc.dispatch("GET", "/_nodes/stats", {}, None)
+        ip = st.body["nodes"][node.node_id]["indexing_pressure"]
+        assert ip["memory"]["total"]["coordinating_rejections"] == 1
+    finally:
+        node.close()
+
+
+# ------------------------------------------- bounded coordinator reduce ----
+
+
+def test_incremental_reduce_bounds_window_and_matches_full():
+    from elasticsearch_tpu.action.search_action import (
+        _QueryPhaseResultConsumer,
+    )
+    from elasticsearch_tpu.common.breaker import CircuitBreaker
+
+    rng = np.random.default_rng(5)
+    body = {"size": 10, "batched_reduce_size": 4,
+            "aggs": {"m": {"max": {"field": "n"}}}}
+    breaker = CircuitBreaker("request", 64 << 20)
+    c = _QueryPhaseResultConsumer(body, sort=None, k=10, breaker=breaker)
+    all_hits = []
+    for si in range(20):                       # 20 shards, 10 hits each
+        hits = []
+        for j in range(10):
+            score = float(rng.random())
+            h = {"leaf_idx": 0, "ord": j, "score": score,
+                 "global_ord": j, "sort_values": None}
+            hits.append(h)
+            all_hits.append((score, si, j))
+        c.consume(si, {"total": 10, "relation": "eq", "hits": hits,
+                       "aggs": encode_value({"m": {"max": np.float64(si)}})})
+        # bounded: never more than batch x per-shard hits + window pending
+        assert len(c.window) <= 10
+    window, agg_state = c.finish()
+    assert c.n_reduce_steps >= 5               # reduced incrementally
+    assert breaker.used_bytes == 0             # everything released
+    assert c.total == 200
+    # identical to a full sort of every hit
+    all_hits.sort(key=lambda t: (-t[0], t[1], t[2]))
+    expect = [(si, j) for _, si, j in all_hits[:10]]
+    assert [(si, h["ord"]) for si, h in window] == expect
+
+
+def test_incremental_reduce_breaker_trips_on_huge_partials():
+    from elasticsearch_tpu.action.search_action import (
+        _QueryPhaseResultConsumer,
+    )
+    from elasticsearch_tpu.common.breaker import CircuitBreaker
+    from elasticsearch_tpu.common.errors import CircuitBreakingError
+
+    body = {"size": 1, "batched_reduce_size": 512}   # no fold before trip
+    breaker = CircuitBreaker("request", 1024)
+    c = _QueryPhaseResultConsumer(body, sort=None, k=1, breaker=breaker)
+    part = encode_value({"big": np.zeros(4096, np.float64)})
+    with pytest.raises(CircuitBreakingError):
+        for si in range(10):
+            c.consume(si, {"total": 0, "relation": "eq", "hits": [],
+                           "aggs": part})
+
+

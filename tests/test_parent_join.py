@@ -1,0 +1,116 @@
+"""Parent-join: join field + has_child/has_parent/parent_id (VERDICT r4
+item 6; ref: modules/parent-join/)."""
+
+import pytest
+
+from elasticsearch_tpu.cluster.state import IndexMetadata
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture(scope="module")
+def svc():
+    meta = IndexMetadata(
+        index="jn", uuid="u_jn", settings=Settings({}),
+        mappings={"properties": {
+            "jf": {"type": "join",
+                   "relations": {"question": "answer"}},
+            "body": {"type": "text"},
+            "votes": {"type": "integer"},
+        }})
+    svc = IndexService(meta)
+    svc.index_doc("q1", {"jf": "question", "body": "how do tpus work"})
+    svc.index_doc("q2", {"jf": {"name": "question"},
+                         "body": "what is xla"})
+    svc.index_doc("q3", {"jf": "question", "body": "unanswered question"})
+    svc.index_doc("a1", {"jf": {"name": "answer", "parent": "q1"},
+                         "body": "systolic arrays", "votes": 7})
+    svc.index_doc("a2", {"jf": {"name": "answer", "parent": "q1"},
+                         "body": "matrix units", "votes": 2})
+    svc.index_doc("a3", {"jf": {"name": "answer", "parent": "q2"},
+                         "body": "a compiler", "votes": 5})
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+def _ids(r):
+    return sorted(h["_id"] for h in r["hits"]["hits"])
+
+
+def test_has_child_matches_parents(svc):
+    r = svc.search({"query": {"has_child": {
+        "type": "answer", "query": {"match": {"body": "arrays"}}}}})
+    assert _ids(r) == ["q1"]
+    r2 = svc.search({"query": {"has_child": {
+        "type": "answer", "query": {"match_all": {}}}}})
+    assert _ids(r2) == ["q1", "q2"]     # q3 has no children
+
+
+def test_has_child_min_children(svc):
+    r = svc.search({"query": {"has_child": {
+        "type": "answer", "query": {"match_all": {}},
+        "min_children": 2}}})
+    assert _ids(r) == ["q1"]
+
+
+def test_has_child_score_modes(svc):
+    for mode, expect in [("sum", 2.0), ("max", 1.0), ("avg", 1.0)]:
+        r = svc.search({"query": {"has_child": {
+            "type": "answer", "query": {"match_all": {}},
+            "score_mode": mode}}})
+        q1 = [h for h in r["hits"]["hits"] if h["_id"] == "q1"][0]
+        assert abs(q1["_score"] - expect) < 1e-5, mode
+
+
+def test_has_parent_matches_children(svc):
+    r = svc.search({"query": {"has_parent": {
+        "parent_type": "question", "query": {"match": {"body": "xla"}}}}})
+    assert _ids(r) == ["a3"]
+
+
+def test_parent_id(svc):
+    r = svc.search({"query": {"parent_id": {"type": "answer",
+                                            "id": "q1"}}})
+    assert _ids(r) == ["a1", "a2"]
+
+
+def test_join_relation_name_is_term_searchable(svc):
+    r = svc.search({"query": {"term": {"jf": "question"}}, "size": 10})
+    assert _ids(r) == ["q1", "q2", "q3"]
+
+
+def test_join_combines_with_bool(svc):
+    r = svc.search({"query": {"bool": {
+        "must": [{"has_child": {"type": "answer",
+                                "query": {"range": {"votes": {"gte": 6}}}}}],
+    }}})
+    assert _ids(r) == ["q1"]
+
+
+def test_join_respects_child_deletes(svc):
+    meta = IndexMetadata(
+        index="jn2", uuid="u_jn2", settings=Settings({}),
+        mappings={"properties": {
+            "jf": {"type": "join", "relations": {"p": "c"}}}})
+    s2 = IndexService(meta)
+    s2.index_doc("p1", {"jf": "p"})
+    s2.index_doc("c1", {"jf": {"name": "c", "parent": "p1"}})
+    s2.refresh()
+    s2.delete_doc("c1")
+    s2.refresh()
+    r = s2.search({"query": {"has_child": {
+        "type": "c", "query": {"match_all": {}}}}})
+    assert _ids(r) == []
+    s2.close()
+
+
+def test_join_child_without_parent_rejected(svc):
+    with pytest.raises(ElasticsearchTpuError):
+        svc.index_doc("bad", {"jf": {"name": "answer"}})
+
+
+def test_join_unknown_relation_rejected(svc):
+    with pytest.raises(ElasticsearchTpuError):
+        svc.index_doc("bad2", {"jf": "comment"})

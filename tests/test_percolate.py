@@ -1,0 +1,106 @@
+"""Percolator: store queries, match documents against them (VERDICT r4
+item 6; ref: modules/percolator/ candidate-prefilter + memory-index
+replay)."""
+
+import pytest
+
+from elasticsearch_tpu.cluster.state import IndexMetadata
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture(scope="module")
+def svc():
+    meta = IndexMetadata(
+        index="perc", uuid="u_pc", settings=Settings({}),
+        mappings={"properties": {
+            "query": {"type": "percolator"},
+            "body": {"type": "text"},
+            "tag": {"type": "keyword"},
+            "n": {"type": "integer"},
+        }})
+    svc = IndexService(meta)
+    stored = [
+        ("q_match", {"match": {"body": "quick fox"}}),
+        ("q_term", {"term": {"tag": "urgent"}}),
+        ("q_bool", {"bool": {"must": [{"match": {"body": "brown"}}],
+                             "filter": [{"term": {"tag": "news"}}]}}),
+        ("q_range", {"range": {"n": {"gte": 100}}}),      # no terms: ALWAYS
+        ("q_phrase", {"match_phrase": {"body": "lazy dog"}}),
+        ("q_none", {"match_none": {}}),
+    ]
+    for qid, body in stored:
+        svc.index_doc(qid, {"query": body})
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+def _ids(r):
+    return sorted(h["_id"] for h in r["hits"]["hits"])
+
+
+def test_percolate_match_and_term(svc):
+    r = svc.search({"query": {"percolate": {
+        "field": "query",
+        "document": {"body": "the quick brown fox", "tag": "news",
+                     "n": 5}}}})
+    assert _ids(r) == ["q_bool", "q_match"]
+
+
+def test_percolate_range_always_verified(svc):
+    r = svc.search({"query": {"percolate": {
+        "field": "query", "document": {"n": 150}}}})
+    assert _ids(r) == ["q_range"]
+    r2 = svc.search({"query": {"percolate": {
+        "field": "query", "document": {"n": 50}}}})
+    assert _ids(r2) == []
+
+
+def test_percolate_phrase_needs_order(svc):
+    hit = svc.search({"query": {"percolate": {
+        "field": "query", "document": {"body": "such a lazy dog here"}}}})
+    assert _ids(hit) == ["q_phrase"]
+    miss = svc.search({"query": {"percolate": {
+        "field": "query", "document": {"body": "dog lazy"}}}})
+    assert _ids(miss) == []
+
+
+def test_percolate_multiple_documents_any_match(svc):
+    r = svc.search({"query": {"percolate": {
+        "field": "query",
+        "documents": [{"body": "nothing relevant"},
+                      {"tag": "urgent"}]}}})
+    assert _ids(r) == ["q_term"]
+
+
+def test_percolate_in_bool_filter(svc):
+    r = svc.search({"query": {"bool": {
+        "must": [{"percolate": {"field": "query",
+                                "document": {"tag": "urgent"}}}],
+        "filter": [{"ids": {"values": ["q_term", "q_match"]}}]}}})
+    assert _ids(r) == ["q_term"]
+
+
+def test_percolate_respects_deletes(svc):
+    meta = IndexMetadata(
+        index="perc2", uuid="u_pc2", settings=Settings({}),
+        mappings={"properties": {"query": {"type": "percolator"},
+                                 "body": {"type": "text"}}})
+    s2 = IndexService(meta)
+    s2.index_doc("a", {"query": {"match": {"body": "apple"}}})
+    s2.index_doc("b", {"query": {"match": {"body": "apple banana"}}})
+    s2.refresh()
+    s2.delete_doc("a")
+    s2.refresh()
+    r = s2.search({"query": {"percolate": {
+        "field": "query", "document": {"body": "apple"}}}})
+    assert _ids(r) == ["b"]
+    s2.close()
+
+
+def test_invalid_stored_query_rejected_at_index_time(svc):
+    from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+    with pytest.raises(ElasticsearchTpuError):
+        svc.index_doc("bad", {"query": {"no_such_query": {}}})

@@ -1,0 +1,220 @@
+"""Suggesters (term/phrase/completion) + rescore phase (VERDICT r4 item 4).
+
+Differential where possible: rescore results are checked against a
+manually-computed combination of the two queries' scores; suggesters
+against hand-computable corpora (ref: the reference's
+TermSuggestionBuilderTests / phrase + completion suggester semantics)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster.state import IndexMetadata
+from elasticsearch_tpu.common.errors import IllegalArgumentError
+from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture(scope="module")
+def svc():
+    meta = IndexMetadata(
+        index="sugg", uuid="u_sg", settings=Settings({}),
+        mappings={"properties": {
+            "body": {"type": "text"},
+            "title": {"type": "text"},
+            "sugg": {"type": "completion"},
+            "n": {"type": "integer"},
+        }})
+    svc = IndexService(meta)
+    docs = [
+        ("hello world again", "alpha", {"input": ["Hotel Berlin", "Berlin"],
+                                        "weight": 10}),
+        ("hello there world", "beta", {"input": "Hotel Amsterdam",
+                                       "weight": 5}),
+        ("the quick brown fox jumps", "gamma", "Hostel Paris"),
+        ("quick brown foxes leap high", "delta", ["Hotel Paris", "Paris"]),
+        ("hello hello world peace", "alpha beta", {"input": "Hot Dog Stand",
+                                                   "weight": 2}),
+        ("world peace now", "gamma delta", "Hotelier"),
+    ]
+    for i, (body, title, sugg) in enumerate(docs):
+        svc.index_doc(str(i), {"body": body, "title": title, "sugg": sugg,
+                               "n": i})
+    svc.refresh()
+    yield svc
+    svc.close()
+
+
+# ---------------------------------------------------------------- term ----
+
+
+def test_term_suggester_corrects_typo(svc):
+    r = svc.search({"suggest": {
+        "fix": {"text": "helol wrold", "term": {"field": "body"}}}})
+    entries = r["suggest"]["fix"]
+    assert [e["text"] for e in entries] == ["helol", "wrold"]
+    assert entries[0]["options"][0]["text"] == "hello"
+    assert entries[0]["options"][0]["freq"] == 3       # docs containing hello
+    assert entries[1]["options"][0]["text"] == "world"
+    assert entries[1]["offset"] == 6
+
+
+def test_term_suggester_missing_mode_skips_known_words(svc):
+    r = svc.search({"suggest": {
+        "fix": {"text": "hello wrold", "term": {"field": "body"}}}})
+    entries = r["suggest"]["fix"]
+    assert entries[0]["options"] == []     # "hello" exists -> no suggestions
+    assert entries[1]["options"][0]["text"] == "world"
+
+
+def test_term_suggester_always_and_sort_frequency(svc):
+    r = svc.search({"suggest": {
+        "fix": {"text": "quick", "term": {
+            "field": "body", "suggest_mode": "always",
+            "sort": "frequency", "max_edits": 2,
+            "min_word_length": 3}}}})
+    opts = r["suggest"]["fix"][0]["options"]
+    assert all(o["freq"] >= 1 for o in opts)
+
+
+# -------------------------------------------------------------- phrase ----
+
+
+def test_phrase_suggester_corrects_sequence(svc):
+    r = svc.search({"suggest": {
+        "ph": {"text": "helo world",
+               "phrase": {"field": "body", "max_errors": 2.0,
+                          "confidence": 0.0}}}})
+    entry = r["suggest"]["ph"][0]
+    assert entry["text"] == "helo world"
+    assert any(o["text"] == "hello world" for o in entry["options"])
+
+
+def test_phrase_suggester_highlight(svc):
+    r = svc.search({"suggest": {
+        "ph": {"text": "helo world",
+               "phrase": {"field": "body", "max_errors": 2.0,
+                          "confidence": 0.0,
+                          "highlight": {"pre_tag": "<em>",
+                                        "post_tag": "</em>"}}}}})
+    opts = r["suggest"]["ph"][0]["options"]
+    target = [o for o in opts if o["text"] == "hello world"]
+    assert target and target[0]["highlighted"] == "<em>hello</em> world"
+
+
+# ---------------------------------------------------------- completion ----
+
+
+def test_completion_prefix_and_weight_order(svc):
+    r = svc.search({"suggest": {
+        "c": {"prefix": "hot", "completion": {"field": "sugg"}}}})
+    opts = r["suggest"]["c"][0]["options"]
+    texts = [o["text"] for o in opts]
+    # weight-ranked: Hotel Berlin (10) first, then Hotel Amsterdam (5)
+    assert texts[0] == "Hotel Berlin"
+    assert texts[1] == "Hotel Amsterdam"
+    assert all(t.lower().startswith("hot") for t in texts)
+
+
+def test_completion_respects_deletes(svc):
+    meta = IndexMetadata(
+        index="sugg2", uuid="u_sg2", settings=Settings({}),
+        mappings={"properties": {"sugg": {"type": "completion"}}})
+    s2 = IndexService(meta)
+    s2.index_doc("1", {"sugg": {"input": "apple", "weight": 9}})
+    s2.index_doc("2", {"sugg": {"input": "apricot", "weight": 1}})
+    s2.refresh()
+    s2.delete_doc("1")
+    s2.refresh()
+    r = s2.search({"suggest": {
+        "c": {"prefix": "ap", "completion": {"field": "sugg"}}}})
+    texts = [o["text"] for o in r["suggest"]["c"][0]["options"]]
+    assert texts == ["apricot"]
+    s2.close()
+
+
+def test_suggest_only_body_and_global_text(svc):
+    r = svc.search({"size": 0, "suggest": {
+        "text": "wrold",
+        "a": {"term": {"field": "body"}},
+        "b": {"term": {"field": "title"}}}})
+    assert r["suggest"]["a"][0]["options"][0]["text"] == "world"
+    assert r["hits"]["hits"] == []
+
+
+def test_suggest_unknown_kind_rejected(svc):
+    with pytest.raises(IllegalArgumentError):
+        svc.search({"suggest": {"x": {"text": "a", "bogus": {}}}})
+
+
+# ------------------------------------------------------------- rescore ----
+
+
+def _score_of(svc, body, doc_id):
+    r = svc.search(body)
+    for h in r["hits"]["hits"]:
+        if h["_id"] == doc_id:
+            return h["_score"]
+    return None
+
+
+def test_rescore_total_combines_scores(svc):
+    base = {"query": {"match": {"body": "world"}}, "size": 10}
+    plain = svc.search(base)
+    resc = svc.search({**base, "rescore": {
+        "window_size": 10,
+        "query": {"rescore_query": {"match": {"body": "hello"}},
+                  "query_weight": 1.0, "rescore_query_weight": 2.0}}})
+    # every rescored hit's score == orig + 2 * hello-score (or orig alone)
+    hello_scores = {h["_id"]: h["_score"] for h in
+                    svc.search({"query": {"match": {"body": "hello"}},
+                                "size": 20})["hits"]["hits"]}
+    plain_scores = {h["_id"]: h["_score"] for h in plain["hits"]["hits"]}
+    for h in resc["hits"]["hits"]:
+        expect = plain_scores[h["_id"]] + 2.0 * hello_scores.get(h["_id"], 0.0)
+        assert abs(h["_score"] - expect) < 1e-4, h["_id"]
+    # and the order follows the combined score
+    scores = [h["_score"] for h in resc["hits"]["hits"]]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_rescore_window_limits_reranking(svc):
+    base = {"query": {"match": {"body": "world"}}, "size": 10}
+    resc = svc.search({**base, "rescore": {
+        "window_size": 1,
+        "query": {"rescore_query": {"match": {"body": "peace"}},
+                  "rescore_query_weight": 100.0}}})
+    plain = svc.search(base)
+    # only the top-1 doc could change score; tail order preserved
+    assert [h["_id"] for h in resc["hits"]["hits"][1:]] == \
+        [h["_id"] for h in plain["hits"]["hits"][1:]]
+
+
+def test_rescore_score_modes(svc):
+    base = {"query": {"match": {"body": "world"}}, "size": 10}
+    for mode in ("total", "multiply", "avg", "max", "min"):
+        r = svc.search({**base, "rescore": {
+            "window_size": 10,
+            "query": {"rescore_query": {"match": {"body": "hello"}},
+                      "score_mode": mode}}})
+        assert r["hits"]["hits"], mode
+
+
+def test_rescore_rejects_field_sort(svc):
+    with pytest.raises(IllegalArgumentError):
+        svc.search({"query": {"match": {"body": "world"}},
+                    "sort": [{"n": "asc"}],
+                    "rescore": {"query": {
+                        "rescore_query": {"match": {"body": "hello"}}}}})
+
+
+def test_rescore_multiple_passes(svc):
+    base = {"query": {"match": {"body": "world"}}, "size": 10}
+    r = svc.search({**base, "rescore": [
+        {"window_size": 10, "query": {
+            "rescore_query": {"match": {"body": "hello"}}}},
+        {"window_size": 5, "query": {
+            "rescore_query": {"match": {"body": "peace"}},
+            "rescore_query_weight": 3.0}},
+    ]})
+    scores = [h["_score"] for h in r["hits"]["hits"][:5]]
+    assert scores == sorted(scores, reverse=True)

@@ -88,72 +88,55 @@ def test_cold_only_queries_exact(engine):
 
 def test_colized_path_exact():
     # small dense corpus with COLD_DF forced low so columns engage
-    import elasticsearch_tpu.parallel.turbo as turbo_mod
 
     fp, probs, rng = _corpus(n_docs=2000, vocab=50, seed=1)
     stacked = build_stacked_bm25([_Seg(2000, fp)], "body", serve_only=True)
-    old = turbo_mod.COLD_DF
-    turbo_mod.COLD_DF = 10
-    try:
-        turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20)
-        queries = [[f"t{a}", f"t{b}"] for a, b in
-                   rng.integers(0, 50, size=(12, 2))]
-        scores, ords = turbo.search(queries, k=10)
-        for qi, q in enumerate(queries):
-            bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
-                            _agg(q), k=10)
-            n = len(bd)
-            assert np.array_equal(ords[qi][:n], bd), f"query {qi} docs"
-            np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
-        assert turbo.stats["builds"] > 0
-    finally:
-        turbo_mod.COLD_DF = old
+    turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=10)
+    queries = [[f"t{a}", f"t{b}"] for a, b in
+               rng.integers(0, 50, size=(12, 2))]
+    scores, ords = turbo.search(queries, k=10)
+    for qi, q in enumerate(queries):
+        bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
+                        _agg(q), k=10)
+        n = len(bd)
+        assert np.array_equal(ords[qi][:n], bd), f"query {qi} docs"
+        np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
+    assert turbo.stats["builds"] > 0
 
 
 def test_live_mask_filters_deleted():
-    import elasticsearch_tpu.parallel.turbo as turbo_mod
 
     fp, probs, rng = _corpus(n_docs=1500, vocab=40, seed=2)
     live = np.ones(1500, bool)
     live[::3] = False
     stacked = build_stacked_bm25([_Seg(1500, fp)], "body",
                                  live_masks=[live], serve_only=True)
-    old = turbo_mod.COLD_DF
-    turbo_mod.COLD_DF = 10
-    try:
-        turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20)
-        queries = [[f"t{a}", f"t{b}"] for a, b in
-                   rng.integers(0, 40, size=(6, 2))]
-        scores, ords = turbo.search(queries, k=10)
-        for qi, q in enumerate(queries):
-            bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
-                            _agg(q),
-                            k=10, live=live)
-            n = len(bd)
-            assert np.array_equal(ords[qi][:n], bd)
-            np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
-    finally:
-        turbo_mod.COLD_DF = old
+    turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=10)
+    queries = [[f"t{a}", f"t{b}"] for a, b in
+               rng.integers(0, 40, size=(6, 2))]
+    scores, ords = turbo.search(queries, k=10)
+    for qi, q in enumerate(queries):
+        bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
+                        _agg(q),
+                        k=10, live=live)
+        n = len(bd)
+        assert np.array_equal(ords[qi][:n], bd)
+        np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
 
 
 def test_mixed_and_boosted_queries():
-    import elasticsearch_tpu.parallel.turbo as turbo_mod
 
     fp, probs, rng = _corpus(n_docs=2500, vocab=120, seed=3)
     stacked = build_stacked_bm25([_Seg(2500, fp)], "body", serve_only=True)
-    old = turbo_mod.COLD_DF
-    turbo_mod.COLD_DF = 60     # head terms colized, tail cold -> mixed
-    try:
-        turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20)
-        queries = [[("t0", 2.0), (f"t{100 + i}", 1.0)] for i in range(8)]
-        scores, ords = turbo.search(queries, k=10)
-        for qi, q in enumerate(queries):
-            bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs, q, k=10)
-            n = len(bd)
-            assert np.array_equal(ords[qi][:n], bd), f"query {qi}"
-            np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
-    finally:
-        turbo_mod.COLD_DF = old
+    # head terms colized, tail cold -> mixed
+    turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=60)
+    queries = [[("t0", 2.0), (f"t{100 + i}", 1.0)] for i in range(8)]
+    scores, ords = turbo.search(queries, k=10)
+    for qi, q in enumerate(queries):
+        bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs, q, k=10)
+        n = len(bd)
+        assert np.array_equal(ords[qi][:n], bd), f"query {qi}"
+        np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
 
 
 def test_missing_terms_and_empty():
@@ -166,3 +149,38 @@ def test_missing_terms_and_empty():
     bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs,
                     [("t0", 1.0)], k=5)
     assert np.array_equal(ords[1][: len(bd)], bd)
+
+
+def test_capacity_overflow_degrades_to_cold():
+    """A batch whose colizable terms exceed cache capacity must degrade
+    gracefully (ADVICE r4): overflow terms score host-exact, results stay
+    identical to brute force."""
+    fp, probs, rng = _corpus(n_docs=3000, vocab=80, seed=7)
+    stacked = build_stacked_bm25([_Seg(3000, fp)], "body", serve_only=True)
+    # hbm budget floor is 32 slots; make nearly every term colizable so one
+    # batch demands more columns than capacity
+    turbo = TurboBM25(stacked, hbm_budget_bytes=1, cold_df=5)
+    assert turbo.Hp == 32
+    queries = [[f"t{i}", f"t{(i + 37) % 80}"] for i in range(40)]
+    scores, ords = turbo.search(queries, k=10)
+    assert turbo.stats["degraded"] > 0
+    for qi, q in enumerate(queries):
+        bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs, _agg(q), k=10)
+        n = len(bd)
+        assert np.array_equal(ords[qi][:n], bd), f"query {qi}"
+        np.testing.assert_allclose(scores[qi][:n], bs, rtol=1e-6)
+
+
+def test_qc_sizes_rounded_and_intermediate_used():
+    fp, probs, rng = _corpus(n_docs=1200, vocab=30, seed=8)
+    stacked = build_stacked_bm25([_Seg(1200, fp)], "body", serve_only=True)
+    turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=10,
+                      qc_sizes=(3, 20, 64))
+    # rounded up to ROWS_PER_STEP multiples, deduped, ascending
+    assert turbo.qc_sizes == (8, 24, 64)
+    queries = [[f"t{i % 30}", f"t{(i + 11) % 30}"] for i in range(17)]
+    scores, ords = turbo.search(queries, k=5)   # 17 -> qc 24 (intermediate)
+    for qi, q in enumerate(queries):
+        bs, bd = _brute(fp, stacked.avgdl, stacked.total_docs, _agg(q), k=5)
+        n = len(bd)
+        assert np.array_equal(ords[qi][:n], bd), f"query {qi}"
