@@ -1,0 +1,103 @@
+"""Sweep the reference YAML corpus through the conformance runner; tally."""
+import json, sys, traceback
+from pathlib import Path
+from collections import Counter
+sys.path.insert(0, "tests")
+from conformance.runner import API_TABLE, StepFailure, YamlTestRunner
+import yaml
+
+REF = Path("/root/reference/rest-api-spec/src/main/resources/rest-api-spec/test")
+
+def collect_apis(steps, out):
+    for step in steps or []:
+        if isinstance(step, dict) and "do" in step:
+            spec = dict(step["do"])
+            spec.pop("catch", None); spec.pop("headers", None)
+            spec.pop("warnings", None); spec.pop("allowed_warnings", None)
+            spec.pop("node_selector", None)
+            if len(spec) == 1:
+                out.add(next(iter(spec)))
+
+SUPPORTED_FEATURES = {"default_shards", "stash_in_key", "stash_in_path", "stash_path_replace", "allowed_warnings", "warnings", "warnings_regex", "allowed_warnings_regex", "headers", "node_selector", "arbitrary_key"}
+
+def load_file(f):
+    docs = list(yaml.safe_load_all(f.read_text()))
+    setup, teardown, tests = None, None, []
+    for doc in docs:
+        if not doc: continue
+        for name, steps in doc.items():
+            if name == "setup": setup = steps
+            elif name == "teardown": teardown = steps
+            else: tests.append((name, steps))
+    return setup, teardown, tests
+
+def mk_node():
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import RestController, register_handlers
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+    def dispatch(method, path, params, raw):
+        r = rc.dispatch(method, path, params, raw)
+        return r.status, r.body
+    return node, dispatch
+
+def wipe(dispatch):
+    dispatch("DELETE", "/*", {}, None)
+
+results = Counter()
+passes = []
+fail_reasons = Counter()
+fails = []
+files = sorted(REF.rglob("*.yml"))
+for f in files:
+    try:
+        setup, teardown, tests = load_file(f)
+    except Exception as e:
+        results["load_error"] += len(1 for _ in [1]); continue
+    node, dispatch = mk_node()
+    try:
+        for name, steps in tests:
+            apis = set()
+            collect_apis(setup, apis); collect_apis(steps, apis)
+            missing = [a for a in apis if a not in API_TABLE]
+            if missing:
+                results["skip_api"] += 1
+                fail_reasons["api:" + missing[0]] += 1
+                continue
+            # feature skips
+            feats = set()
+            for blk in (setup or []) + steps:
+                if isinstance(blk, dict) and "skip" in blk:
+                    sk = blk["skip"] or {}
+                    for feat in (sk.get("features") or []) if isinstance(sk.get("features"), list) else ([sk["features"]] if sk.get("features") else []):
+                        feats.add(feat)
+            unsupported = feats - SUPPORTED_FEATURES
+            if unsupported:
+                results["skip_feature"] += 1
+                fail_reasons["feat:" + sorted(unsupported)[0]] += 1
+                continue
+            wipe(dispatch)
+            runner = YamlTestRunner(dispatch)
+            try:
+                if setup: runner.run_steps(setup)
+                runner.run_steps(steps)
+                results["pass"] += 1
+                passes.append([str(f.relative_to(REF)), name])
+            except StepFailure as e:
+                results["fail"] += 1
+                fail_reasons["F:" + str(e)[:80]] += 1
+                fails.append((str(f.relative_to(REF)), name, str(e)[:160]))
+            except Exception as e:
+                results["error"] += 1
+                fail_reasons["E:" + type(e).__name__ + ":" + str(e)[:60]] += 1
+                fails.append((str(f.relative_to(REF)), name, "E:" + str(e)[:160]))
+    finally:
+        node.close()
+
+print(json.dumps(results, indent=0))
+print("\nTop reasons:")
+for reason, n in fail_reasons.most_common(40):
+    print(f"{n:5d}  {reason}")
+json.dump(fails, open("/tmp/conf_fails.json","w"), indent=1)
+json.dump(sorted(passes), open("tests/conformance/reference_green.json","w"), indent=0)

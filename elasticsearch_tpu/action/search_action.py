@@ -328,6 +328,10 @@ class SearchActionService:
         targets: List[Tuple[str, str, int]] = []   # (node, index, shard_id)
         for index in indices:
             meta = state.indices[index]
+            if meta.state == "close":
+                from elasticsearch_tpu.common.errors import IndexClosedError
+
+                raise IndexClosedError(f"closed index [{index}]")
             for sid in range(meta.number_of_shards):
                 copies = [r for r in state.shard_copies(index, sid)
                           if r.state == "STARTED" and r.node_id is not None]

@@ -34,7 +34,8 @@ from elasticsearch_tpu.cluster.state import (
 )
 from elasticsearch_tpu.cluster.store import LocalStateStore, NotMasterError
 from elasticsearch_tpu.common.errors import (
-    ElasticsearchTpuError, IndexNotFoundError, ResourceAlreadyExistsError,
+    ElasticsearchTpuError, IllegalArgumentError, IndexClosedError,
+    IndexNotFoundError, ResourceAlreadyExistsError,
 )
 from elasticsearch_tpu.common.settings import Settings
 from elasticsearch_tpu.indices.cluster_state_service import (
@@ -72,6 +73,10 @@ class ClusterNode:
         t = self.transport
         t.register_request_handler("indices:admin/create",
                                    self._on_create_index)
+        t.register_request_handler("indices:admin/aliases",
+                                   self._on_update_aliases)
+        t.register_request_handler("indices:admin/state",
+                                   self._on_set_index_state)
         t.register_request_handler("indices:admin/delete",
                                    self._on_delete_index)
         t.register_request_handler("internal:cluster/shard/started",
@@ -160,6 +165,69 @@ class ClusterNode:
         self.store.submit(updater)
         return {"acknowledged": True}
 
+    def _on_update_aliases(self, req) -> dict:
+        """Master action behind _aliases / rollover (ref:
+        cluster/metadata/MetadataIndexAliasesService.java): apply
+        add/remove alias actions as one atomic cluster-state update, so a
+        rollover's demote-old/promote-new swap cannot be observed
+        half-done."""
+        import dataclasses
+
+        self._require_master()
+        actions = req.payload.get("actions") or []
+
+        def updater(state: ClusterState) -> ClusterState:
+            metas = dict(state.indices)
+            for action in actions:
+                op, spec = next(iter(action.items()))
+                name = spec["index"]
+                meta = metas.get(name)
+                if meta is None:
+                    raise IndexNotFoundError(name)
+                aliases = dict(meta.aliases)
+                if op == "add":
+                    aliases[spec["alias"]] = {
+                        k: v for k, v in spec.items()
+                        if k not in ("index", "alias")}
+                elif op == "remove":
+                    aliases.pop(spec["alias"], None)
+                else:
+                    raise IllegalArgumentError(
+                        f"unsupported alias action [{op}]")
+                metas[name] = dataclasses.replace(
+                    meta, aliases=aliases, version=meta.version + 1)
+            new = state
+            for name, meta in metas.items():
+                if meta is not state.indices.get(name):
+                    new = new.with_index(meta, new.routing[name])
+            return new
+
+        self.store.submit(updater)
+        return {"acknowledged": True}
+
+    def _on_set_index_state(self, req) -> dict:
+        """Open/close as a pure cluster-state transition (ref:
+        MetadataIndexStateService.java); data nodes enforce the block when
+        the applied state reaches them."""
+        import dataclasses
+
+        self._require_master()
+        name = req.payload["name"]
+        target = req.payload["state"]
+        if target not in ("open", "close"):
+            raise IllegalArgumentError(f"invalid index state [{target}]")
+
+        def updater(state: ClusterState) -> ClusterState:
+            meta = state.indices.get(name)
+            if meta is None:
+                raise IndexNotFoundError(name)
+            new_meta = dataclasses.replace(meta, state=target,
+                                           version=meta.version + 1)
+            return state.with_index(new_meta, state.routing[name])
+
+        self.store.submit(updater)
+        return {"acknowledged": True}
+
     def _on_shard_started(self, req) -> dict:
         self._require_master()
         p = req.payload
@@ -221,6 +289,72 @@ class ClusterNode:
     def delete_index(self, name: str) -> dict:
         return self.master_client("indices:admin/delete", {"name": name})
 
+    def update_aliases(self, actions: List[dict]) -> dict:
+        return self.master_client("indices:admin/aliases",
+                                  {"actions": actions})
+
+    def close_index(self, name: str) -> dict:
+        return self.master_client("indices:admin/state",
+                                  {"name": name, "state": "close"})
+
+    def open_index(self, name: str) -> dict:
+        return self.master_client("indices:admin/state",
+                                  {"name": name, "state": "open"})
+
+    def resolve_write_index(self, name: str) -> str:
+        """Alias -> concrete write index (single holder, or the
+        is_write_index one among several)."""
+        state = self.state
+        if name in state.indices:
+            return name
+        holders = [(n, state.indices[n].aliases[name])
+                   for n in sorted(state.indices)
+                   if name in state.indices[n].aliases]
+        if not holders:
+            raise IndexNotFoundError(name)
+        if len(holders) == 1:
+            return holders[0][0]
+        writers = [n for n, spec in holders if spec.get("is_write_index")]
+        if len(writers) != 1:
+            raise IllegalArgumentError(
+                f"no write index is defined for alias [{name}]")
+        return writers[0]
+
+    def rollover(self, alias: str, body: Optional[dict] = None) -> dict:
+        """Coordinator-side rollover over master actions (shared mechanics
+        in indices/rollover.py; conditions needing node-local store stats
+        are not available on this path and raise)."""
+        from elasticsearch_tpu.indices.rollover import (
+            evaluate_rollover_conditions, next_rollover_name,
+            rollover_alias_actions,
+        )
+
+        body = body or {}
+        old_name = self.resolve_write_index(alias)
+        meta = self.state.indices[old_name]
+        old_spec = meta.aliases.get(alias, {})
+        conditions = body.get("conditions", {}) or {}
+        metrics = {"max_age": int(time.time() * 1000) - meta.creation_date}
+        if "max_docs" in conditions:
+            metrics["max_docs"] = self.search(old_name, {
+                "size": 0, "track_total_hits": True,
+            })["hits"]["total"]["value"]
+        met = evaluate_rollover_conditions(conditions, metrics)
+        rolled = (not conditions) or any(met.values())
+        new_name = body.get("new_index") or next_rollover_name(old_name)
+        out = {"old_index": old_name, "new_index": new_name,
+               "rolled_over": False, "dry_run": bool(body.get("dry_run")),
+               "conditions": met, "acknowledged": False}
+        if body.get("dry_run") or not rolled:
+            return out
+        self.create_index(new_name, {k: v for k, v in body.items()
+                                     if k in ("settings", "mappings",
+                                              "aliases")})
+        self.update_aliases(rollover_alias_actions(
+            alias, old_name, new_name, old_spec))
+        out.update({"rolled_over": True, "acknowledged": True})
+        return out
+
     def report_node_left(self, *names: str) -> dict:
         return self.master_client("internal:cluster/node/left",
                                   {"nodes": list(names)})
@@ -234,10 +368,13 @@ class ClusterNode:
         (ref: action/bulk/TransportBulkAction.java:164 + the replication
         template). Retries on stale routing — a promoted primary or a moved
         shard shows up in a later cluster state."""
+        index = self.resolve_write_index(index)
         state = self.state
         meta = state.indices.get(index)
         if meta is None:
             raise IndexNotFoundError(index)
+        if meta.state == "close":
+            raise IndexClosedError(f"closed index [{index}]")
         n_shards = meta.number_of_shards
         by_shard: Dict[int, List[Tuple[int, dict]]] = {}
         for pos, op in enumerate(ops):

@@ -57,6 +57,11 @@ class CircuitBreakingError(ElasticsearchTpuError):
     error_type = "circuit_breaking_exception"
 
 
+class IndexClosedError(ElasticsearchTpuError):
+    status = 400
+    error_type = "index_closed_exception"
+
+
 class IllegalArgumentError(ElasticsearchTpuError):
     status = 400
     error_type = "illegal_argument_exception"

@@ -63,17 +63,60 @@ class IndexService:
 
     # ---- document ops ----
 
+    def check_open(self) -> None:
+        """Closed indices reject data ops with index_closed_exception
+        (ref: cluster/block/ClusterBlocks INDEX_CLOSED_BLOCK)."""
+        if getattr(self, "closed", False):
+            from elasticsearch_tpu.common.errors import IndexClosedError
+
+            raise IndexClosedError(f"closed index [{self.name}]")
+
+    def check_write_allowed(self) -> None:
+        """index.blocks.write / read_only reject writes with 403 (ref:
+        ClusterBlocks WRITE + IndexMetadata INDEX_WRITE_BLOCK)."""
+        self.check_open()
+        for key in ("index.blocks.write", "index.blocks.read_only"):
+            if str(self.meta.settings.raw(key, "false")).lower() == "true":
+                from elasticsearch_tpu.common.errors import (
+                    ElasticsearchTpuError,
+                )
+
+                err = ElasticsearchTpuError(
+                    f"index [{self.name}] blocked by: [FORBIDDEN/8/"
+                    f"{key} (api)]")
+                err.status = 403
+                err.error_type = "cluster_block_exception"
+                raise err
+
     def shard_for(self, doc_id: str, routing: str | None = None) -> InternalEngine:
         return self.shards[shard_for_id(doc_id, len(self.shards), routing)]
 
     def index_doc(self, doc_id: str, source: dict, **kw) -> EngineResult:
+        self.check_write_allowed()
         return self.shard_for(doc_id, kw.pop("routing", None)).index(doc_id, source, **kw)
 
     def delete_doc(self, doc_id: str, **kw) -> EngineResult:
+        self.check_write_allowed()
         return self.shard_for(doc_id, kw.pop("routing", None)).delete(doc_id, **kw)
 
     def get_doc(self, doc_id: str, routing: str | None = None) -> Optional[dict]:
+        self.check_open()
         return self.shard_for(doc_id, routing).get(doc_id)
+
+    def store_size_bytes(self) -> int:
+        """Rough resident size of published segments (rollover max_size)."""
+        total = 0
+        for engine in self.shards:
+            for v in engine.acquire_searcher().views:
+                seg = v.segment
+                for fp in seg.postings.values():
+                    total += (fp.block_docs.nbytes + fp.block_tfs.nbytes
+                              + fp.post_doc.nbytes + fp.pos_data.nbytes)
+                for col in seg.numeric.values():
+                    total += col.values.nbytes
+                for vc in seg.vectors.values():
+                    total += vc.vectors.nbytes
+        return total
 
     def refresh(self) -> None:
         for s in self.shards:
@@ -117,6 +160,8 @@ class IndexService:
     def search(self, request: dict, search_type: str = "query_then_fetch",
                searchers=None, task=None) -> dict:
         import copy as _copy
+
+        self.check_open()
 
         key = self._request_cache_key(request, search_type)             if searchers is None else None
         if key is not None:
@@ -176,6 +221,7 @@ class IndexService:
         in that body's slot for the caller to render."""
         from elasticsearch_tpu.common.errors import ElasticsearchTpuError
 
+        self.check_open()
         out = self.serving.try_msearch(requests, search_type)
         results: List = []
         for i, r in enumerate(out):

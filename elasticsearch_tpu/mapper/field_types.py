@@ -355,6 +355,7 @@ _TYPES = {
     "percolator": PercolatorFieldType,
     "join": JoinFieldType,
     "date": DateFieldType,
+    "date_nanos": DateFieldType,
     "boolean": BooleanFieldType,
     "ip": IpFieldType,
     "dense_vector": DenseVectorFieldType,

@@ -79,7 +79,17 @@ class MapperService:
     def merge(self, mappings: dict) -> None:
         """Merge a mapping definition {"properties": {...}}; conflicting type
         changes raise, new fields are added (ref: MapperService.merge)."""
-        props = mappings.get("properties", mappings) or {}
+        props = mappings.get("properties")
+        if props is None:
+            # a bare field map; meta sections (_source, dynamic, ...) are
+            # index options, not fields — but anything shaped like a field
+            # definition (a dict with type/properties) IS a field, whatever
+            # its name
+            props = {k: v for k, v in mappings.items()
+                     if isinstance(v, dict)
+                     and ("type" in v or "properties" in v)
+                     and not k.startswith("_")}
+        props = props or {}
         with self._lock:
             self._merge_props("", props)
 

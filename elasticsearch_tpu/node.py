@@ -41,6 +41,9 @@ class Node:
 
         self.indexing_pressure = IndexingPressure(int(self.settings.raw(
             "indexing_pressure.memory.limit", DEFAULT_LIMIT_BYTES)))
+        from elasticsearch_tpu.security import SecurityService
+
+        self.security = SecurityService(self.settings)
         from elasticsearch_tpu.common.settings import ClusterSettings, Setting
 
         # dynamic cluster settings registry (ref: ClusterSettings + the

@@ -24,6 +24,7 @@ class RestRequest:
     params: Dict[str, str] = field(default_factory=dict)
     body: Any = None
     raw_body: bytes = b""
+    headers: Dict[str, str] = field(default_factory=dict)
 
     def param(self, name: str, default=None):
         return self.params.get(name, default)
@@ -99,13 +100,18 @@ class _Route:
 class RestController:
     def __init__(self):
         self._routes: Dict[str, List[_Route]] = {}
+        # authn/authz action filter (security/service.py) — runs before
+        # every handler when security is enabled (ref: the reference's
+        # SecurityActionFilter wrapping the action chain)
+        self.security_filter = None
 
     def register(self, method: str, pattern: str, handler: Handler) -> None:
         self._routes.setdefault(method.upper(), []).append(_Route(pattern, handler))
         self._routes[method.upper()].sort(key=lambda r: r.specificity, reverse=True)
 
     def dispatch(self, method: str, path: str, params: Dict[str, str] | None = None,
-                 body: bytes | str | None = None) -> RestResponse:
+                 body: bytes | str | None = None,
+                 headers: Dict[str, str] | None = None) -> RestResponse:
         parts = [p for p in path.split("?")[0].split("/") if p]
         routes = self._routes.get(method.upper(), [])
         for route in routes:
@@ -118,8 +124,12 @@ class RestController:
                     err = JsonParseError("request body is not valid JSON")
                     return RestResponse(status=err.status, body=_error_body(err))
                 req = RestRequest(method=method.upper(), path=path, params=req_params,
-                                  body=parsed, raw_body=raw)
+                                  body=parsed, raw_body=raw,
+                                  headers={k.lower(): v for k, v in
+                                           (headers or {}).items()})
                 try:
+                    if self.security_filter is not None:
+                        self.security_filter(req, parts)
                     return route.handler(req)
                 except ElasticsearchTpuError as e:
                     return RestResponse(status=e.status, body=_error_body(e))

@@ -32,7 +32,9 @@ class HttpServer:
                 params = dict(parse_qsl(parts.query, keep_blank_values=True))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
-                resp = outer.controller.dispatch(self.command, parts.path, params, body)
+                resp = outer.controller.dispatch(self.command, parts.path,
+                                                 params, body,
+                                                 headers=dict(self.headers))
                 data = resp.encode()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)

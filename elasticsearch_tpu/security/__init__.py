@@ -1,0 +1,3 @@
+from elasticsearch_tpu.security.service import (  # noqa: F401
+    AuthenticationError, AuthorizationError, SecurityService,
+)

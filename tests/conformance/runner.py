@@ -85,9 +85,35 @@ API_TABLE: Dict[str, Tuple[str, str]] = {
     "indices.delete_index_template": ("DELETE", "/_index_template/{name}"),
     "cluster.get_settings": ("GET", "/_cluster/settings"),
     "cluster.put_settings": ("PUT", "/_cluster/settings"),
+    "indices.close": ("POST", "/{index}/_close"),
+    "indices.open": ("POST", "/{index}/_open"),
+    "indices.rollover": ("POST", "/{alias}/_rollover/{new_index}"),
+    "indices.shrink": ("PUT", "/{index}/_shrink/{target}"),
+    "indices.split": ("PUT", "/{index}/_split/{target}"),
+    "indices.clone": ("PUT", "/{index}/_clone/{target}"),
+    "indices.put_alias": ("PUT", "/{index}/_alias/{name}"),
+    "indices.delete_alias": ("DELETE", "/{index}/_alias/{name}"),
+    "indices.exists_alias": ("HEAD", "/{index}/_alias/{name}"),
+    "indices.get_settings": ("GET", "/{index}/_settings"),
+    "indices.put_settings": ("PUT", "/{index}/_settings"),
+    "indices.get_field_mapping": ("GET", "/{index}/_mapping/field/{fields}"),
+    "indices.put_template": ("PUT", "/_template/{name}"),
+    "indices.get_template": ("GET", "/_template/{name}"),
+    "indices.delete_template": ("DELETE", "/_template/{name}"),
+    "indices.exists_template": ("HEAD", "/_template/{name}"),
+    "indices.exists_index_template": ("HEAD", "/_index_template/{name}"),
+    "cat.aliases": ("GET", "/_cat/aliases"),
+    "cat.templates": ("GET", "/_cat/templates"),
+    "cat.allocation": ("GET", "/_cat/allocation"),
+    "cat.segments": ("GET", "/_cat/segments"),
+    "termvectors": ("POST", "/{index}/_termvectors/{id}"),
+    "rank_eval": ("POST", "/{index}/_rank_eval"),
 }
 
 _NDJSON_APIS = {"bulk", "msearch"}
+# bulk/msearch accept a default index in the path
+API_TABLE["bulk"] = ("POST", "/{index}/_bulk")
+API_TABLE["msearch"] = ("POST", "/{index}/_msearch")
 
 
 class StepFailure(AssertionError):
@@ -126,9 +152,19 @@ class YamlTestRunner:
             return self.last_response
         node = self.last_response
         parts = re.split(r"(?<!\\)\.", path)
-        for raw in parts:
+        for pi, raw in enumerate(parts):
             p = raw.replace("\\.", ".")
             p = self._sub(p)
+            if p == "_arbitrary_key_":
+                # 'arbitrary_key' feature: as the LAST component it yields
+                # any KEY (suites stash node ids); mid-path it descends
+                # into that key's value
+                if not isinstance(node, dict) or not node:
+                    raise StepFailure(f"path [{path}]: no keys for "
+                                      "_arbitrary_key_")
+                key = sorted(node)[0]
+                node = key if pi == len(parts) - 1 else node[key]
+                continue
             if isinstance(node, list):
                 node = node[int(p)]
             elif isinstance(node, dict):
@@ -147,6 +183,11 @@ class YamlTestRunner:
         spec = dict(spec)
         catch = spec.pop("catch", None)
         headers = spec.pop("headers", None)  # accepted, unused
+        spec.pop("warnings", None)           # deprecation warnings: not
+        spec.pop("allowed_warnings", None)   # emitted by this framework
+        spec.pop("allowed_warnings_regex", None)
+        spec.pop("warnings_regex", None)
+        spec.pop("node_selector", None)
         if len(spec) != 1:
             raise StepFailure(f"do step must name one api: {list(spec)}")
         api, params = next(iter(spec.items()))
@@ -155,29 +196,51 @@ class YamlTestRunner:
             raise StepFailure(f"unsupported api [{api}]")
         method, template = API_TABLE[api]
         body = params.pop("body", None)
-        path = template
-        for m in re.findall(r"\{(\w+)\}", template):
-            if m in params:
-                path = path.replace("{" + m, "{" + m)  # keep
+        # optional path params collapse (e.g. /{index}/_refresh -> /_refresh,
+        # /{index}/_doc/{id} without id -> auto-id POST), multi-valued
+        # params join with commas — mirroring the rest-api-spec url variants
+        segs = []
+        for seg in template.split("/"):
+            names = re.findall(r"\{(\w+)\}", seg)
+            if not names:
+                segs.append(seg)
+                continue
+            val = params.pop(names[0], None)
+            if val is None:
+                segs.append(None)
+            elif isinstance(val, list):
+                segs.append(",".join(str(v) for v in val))
             else:
-                # optional path params collapse (e.g. /{index}/_search -> /_search)
-                pass
-        try:
-            path = template.format(**{k: params.pop(k) for k in
-                                      re.findall(r"\{(\w+)\}", template)})
-        except KeyError as e:
-            raise StepFailure(f"[{api}] missing path param {e}")
+                segs.append(str(val))
+        path = "/".join(s for s in segs if s is not None)
+        if not path.startswith("/"):
+            path = "/" + path
+        if api in ("index", "create") and path.endswith("/_doc"):
+            method = "POST"              # auto-generated id variant
         if api in _NDJSON_APIS:
-            lines = body if isinstance(body, list) else [body]
-            raw = ("\n".join(json.dumps(ln) for ln in lines) + "\n").encode()
+            if isinstance(body, (str, bytes)):
+                raw = body.encode() if isinstance(body, str) else body
+            else:
+                lines = body if isinstance(body, list) else [body]
+                raw = ("\n".join(
+                    ln if isinstance(ln, str) else json.dumps(ln)
+                    for ln in lines) + "\n").encode()
         elif body is not None:
-            raw = json.dumps(body).encode()
+            raw = body.encode() if isinstance(body, str) else \
+                json.dumps(body).encode()
         else:
             raw = None
-        qparams = {k: str(v) for k, v in params.items()}
+        qparams = {k: ("true" if v is True else
+                       "false" if v is False else str(v))
+                   for k, v in params.items()}
         status, resp = self.dispatch(method, path, qparams, raw)
         self.last_status = status
         self.last_response = resp
+        if method == "HEAD" and catch is None:
+            # exists-style APIs are boolean: 404 is `false`, not an error
+            # (ref: ClientYamlTestResponse for HEAD)
+            self.last_response = status < 400
+            return
         if catch is not None:
             if status < 400:
                 raise StepFailure(

@@ -1,0 +1,185 @@
+"""Index lifecycle admin: rollover, shrink/split/clone, open/close,
+write-index aliases (VERDICT r4 item 7; ref:
+action/admin/indices/{close,open,shrink,rollover},
+cluster/metadata/MetadataRolloverService.java)."""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest import RestController, register_handlers
+
+
+@pytest.fixture()
+def api():
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+
+    def call(method, path, body=None, params=None):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body)
+        return rc.dispatch(method, path, params or {}, body)
+
+    yield call, node
+    node.close()
+
+
+def _seed(call, index, n, field="f"):
+    for i in range(n):
+        call("PUT", f"/{index}/_doc/{i}", {field: f"value {i}", "n": i})
+    call("POST", f"/{index}/_refresh")
+
+
+# ------------------------------------------------------------ open/close --
+
+
+def test_close_blocks_data_ops_and_open_restores(api):
+    call, _ = api
+    call("PUT", "/c1", {})
+    _seed(call, "c1", 3)
+    r = call("POST", "/c1/_close")
+    assert r.status == 200 and r.body["indices"]["c1"]["closed"]
+    assert call("GET", "/c1/_search").status == 400
+    assert call("PUT", "/c1/_doc/9", {"f": "x"}).status == 400
+    assert "index_closed_exception" in str(
+        call("GET", "/c1/_search").body)
+    # metadata ops still answer
+    assert call("GET", "/c1").status == 200
+    r = call("POST", "/c1/_open")
+    assert r.status == 200
+    assert call("GET", "/c1/_search").status == 200
+    assert call("GET", "/c1/_doc/0").status == 200
+
+
+# -------------------------------------------------------------- rollover --
+
+
+def test_rollover_no_conditions_always_rolls(api):
+    call, node = api
+    call("PUT", "/logs-000001", {"aliases": {"logs": {
+        "is_write_index": True}}})
+    _seed(call, "logs-000001", 2)
+    r = call("POST", "/logs/_rollover")
+    assert r.status == 200, r.body
+    assert r.body["rolled_over"] is True
+    assert r.body["old_index"] == "logs-000001"
+    assert r.body["new_index"] == "logs-000002"
+    # alias moved: new index is the write index, old keeps read alias
+    meta_old = node.cluster_state.indices["logs-000001"]
+    meta_new = node.cluster_state.indices["logs-000002"]
+    assert meta_old.aliases["logs"]["is_write_index"] is False
+    assert meta_new.aliases["logs"]["is_write_index"] is True
+
+
+def test_rollover_conditions_and_dry_run(api):
+    call, _ = api
+    call("PUT", "/ro-000001", {"aliases": {"ro": {"is_write_index": True}}})
+    _seed(call, "ro-000001", 5)
+    r = call("POST", "/ro/_rollover", {"conditions": {"max_docs": 100}})
+    assert r.body["rolled_over"] is False          # condition unmet
+    r = call("POST", "/ro/_rollover", {"conditions": {"max_docs": 3},
+                                       "dry_run": True})
+    assert r.body["rolled_over"] is False and r.body["dry_run"] is True
+    r = call("POST", "/ro/_rollover", {"conditions": {"max_docs": 3}})
+    assert r.body["rolled_over"] is True
+    assert r.body["new_index"] == "ro-000002"
+
+
+def test_rollover_writes_follow_the_alias(api):
+    call, _ = api
+    call("PUT", "/w-000001", {"aliases": {"w": {"is_write_index": True}}})
+    call("PUT", "/w/_doc/a", {"f": "first"})       # via alias
+    call("POST", "/w/_rollover")
+    call("PUT", "/w/_doc/b", {"f": "second"})      # lands in w-000002
+    call("POST", "/w-000001/_refresh")
+    call("POST", "/w-000002/_refresh")
+    r1 = call("GET", "/w-000001/_search")
+    r2 = call("GET", "/w-000002/_search")
+    assert [h["_id"] for h in r1.body["hits"]["hits"]] == ["a"]
+    assert [h["_id"] for h in r2.body["hits"]["hits"]] == ["b"]
+    # searching the alias spans both
+    ra = call("GET", "/w/_search")
+    assert sorted(h["_id"] for h in ra.body["hits"]["hits"]) == ["a", "b"]
+
+
+def test_bulk_writes_resolve_write_alias(api):
+    call, _ = api
+    call("PUT", "/bw-000001", {"aliases": {"bw": {"is_write_index": True}}})
+    nd = '{"index":{"_index":"bw","_id":"1"}}\n{"f":"x"}\n'
+    r = call("POST", "/_bulk", nd)
+    assert r.status == 200 and not r.body["errors"]
+    call("POST", "/bw-000001/_refresh")
+    r = call("GET", "/bw-000001/_search")
+    assert [h["_id"] for h in r.body["hits"]["hits"]] == ["1"]
+
+
+def test_rollover_ambiguous_alias_rejected(api):
+    call, _ = api
+    call("PUT", "/amb-1", {"aliases": {"amb": {}}})
+    call("PUT", "/amb-2", {"aliases": {"amb": {}}})
+    r = call("POST", "/amb/_rollover")
+    assert r.status == 400
+
+
+# ---------------------------------------------------------------- resize --
+
+
+def test_shrink_reduces_shards_and_keeps_docs(api):
+    call, _ = api
+    call("PUT", "/big", {"settings": {"number_of_shards": 4}})
+    _seed(call, "big", 20)
+    r = call("PUT", "/big/_shrink/small",
+             {"settings": {"index.number_of_shards": 2}})
+    assert r.status == 200, r.body
+    r = call("GET", "/small/_count")
+    assert r.body["count"] == 20
+    meta = call("GET", "/small").body["small"]
+    assert meta["settings"]["index"]["number_of_shards"] == "2"
+    # every doc retrievable (routing re-partitioned correctly)
+    for i in range(20):
+        assert call("GET", f"/small/_doc/{i}").status == 200
+
+
+def test_split_multiplies_shards(api):
+    call, _ = api
+    call("PUT", "/narrow", {"settings": {"number_of_shards": 2}})
+    _seed(call, "narrow", 12)
+    r = call("PUT", "/narrow/_split/wide",
+             {"settings": {"index.number_of_shards": 4}})
+    assert r.status == 200, r.body
+    assert call("GET", "/wide/_count").body["count"] == 12
+
+
+def test_clone_keeps_shard_count(api):
+    call, _ = api
+    call("PUT", "/orig", {"settings": {"number_of_shards": 2},
+                          "mappings": {"properties": {
+                              "f": {"type": "text"}}}})
+    _seed(call, "orig", 6)
+    call("DELETE", "/orig/_doc/0")
+    call("POST", "/orig/_refresh")
+    r = call("PUT", "/orig/_clone/copy")
+    assert r.status == 200, r.body
+    assert call("GET", "/copy/_count").body["count"] == 5   # delete honored
+    # searches behave identically
+    q = {"query": {"match": {"f": "value"}}}
+    a = call("POST", "/orig/_search", q).body["hits"]["total"]
+    b = call("POST", "/copy/_search", q).body["hits"]["total"]
+    assert a == b
+
+
+def test_shrink_factor_validation(api):
+    call, _ = api
+    call("PUT", "/odd", {"settings": {"number_of_shards": 3}})
+    r = call("PUT", "/odd/_shrink/bad",
+             {"settings": {"index.number_of_shards": 2}})
+    assert r.status == 400
+
+
+def test_resize_target_exists_rejected(api):
+    call, _ = api
+    call("PUT", "/r1", {})
+    call("PUT", "/r2", {})
+    assert call("PUT", "/r1/_clone/r2").status == 400
