@@ -352,20 +352,28 @@ class TurboBM25:
 
     def _impacts_at(self, info: _TermInfo, docs: np.ndarray) -> np.ndarray:
         """Exact idf-free impact of a term at the given doc ids (0 where
-        the term does not occur)."""
+        the term does not occur). Indexes the [rows, 128] lane matrix
+        directly — ravel()ing the term's lanes here used to copy up to
+        df*4 bytes (36MB for a stopword-grade term) per query and was 90%
+        of serving batch time at 10M docs."""
         fp = self.fp
         lo, hi = int(fp.post_start[info.ord]), int(fp.post_start[info.ord + 1])
         tdocs = fp.post_doc[lo:hi]
-        lanes = self._host_scores[
-            info.row_start: info.row_start + info.n_rows].ravel()[: hi - lo]
-        j = np.searchsorted(tdocs, docs)
-        j_c = np.minimum(j, len(tdocs) - 1) if len(tdocs) else j
-        present = (j < len(tdocs))
-        if len(tdocs):
-            present &= tdocs[j_c] == docs
         out = np.zeros(len(docs), np.float32)
-        if len(tdocs):
-            out[present] = lanes[j_c[present]]
+        if not len(tdocs):
+            return out
+        # needles MUST match the postings dtype: int64 needles make numpy
+        # promote (= copy/cast the multi-million-entry array) per call —
+        # 44ms vs 1.3ms measured for a 9M-df term
+        docs = docs.astype(np.int32, copy=False) \
+            if docs.dtype != tdocs.dtype else docs
+        j = np.searchsorted(tdocs, docs)
+        j_c = np.minimum(j, len(tdocs) - 1)
+        present = (j < len(tdocs))
+        present &= tdocs[j_c] == docs
+        jp = j_c[present]
+        out[present] = self._host_scores[info.row_start + (jp >> 7),
+                                         jp & 127]
         return out
 
     def _exact_merge(self, qterms, k: int):

@@ -724,6 +724,48 @@ class ServingContext:
                 pass
         return out
 
+    def try_query_phase(self, request: dict, task=None):
+        """QUERY-PHASE-ONLY fast path for the DISTRIBUTED shard executor
+        (action/search_action._on_shard_query): eligible disjunctions run
+        on this shard's Turbo/BlockMax engine and come back as a
+        QuerySearchResult (leaf/ord hits, no fetch) so the coordinator's
+        fetch phase and reduce work unchanged. Stats are shard-local —
+        exactly the dense executor's query_then_fetch scope, so results
+        stay bit-identical with the fallback path. Returns None when the
+        dense executor must run."""
+        from elasticsearch_tpu.search.query_phase import (
+            QuerySearchResult, ShardHit,
+        )
+
+        if len(self.svc.shards) != 1:
+            return None             # per-shard adapter always has one
+        plan = extract_plan(request, self.svc.mapper)
+        if plan is None or not plan.is_disjunctive:
+            return None
+        snap = self.snapshot()
+        if snap.total_docs == 0 or not self._disj_servable(
+                plan, snap, request):
+            return None
+        k = int(request.get("from", 0)) + int(request.get("size", 10))
+        eng = snap.engine(plan.field)
+        check = task.check if task is not None else None
+        scores, parts, ords = eng.search_many([[plan.disj]], k=k,
+                                              check=check)[0]
+        hits = []
+        max_score = None
+        for j in range(k):
+            s = float(scores[0, j])
+            if s <= 0 or not np.isfinite(s):
+                break
+            part = snap.partitions[int(parts[0, j])]
+            o = int(ords[0, j])
+            hits.append(ShardHit(leaf_idx=part.leaf_idx, ord=o, score=s,
+                                 global_ord=part.base + o))
+            max_score = s if max_score is None else max(max_score, s)
+        total, relation = self._disj_total(plan, snap, request, len(hits))
+        return QuerySearchResult(total=total, relation=relation, hits=hits,
+                                 max_score=max_score)
+
     # ---- disjunctive (device) ----
 
     def _disj_servable(self, plan, snap, request) -> bool:

@@ -1,0 +1,32 @@
+import os, time
+import numpy as np
+os.environ.setdefault("BENCH_DOCS", "10000000")
+from bench import load_or_build_index, N_DOCS
+lens, tokens, fp = load_or_build_index()
+# head term (big df) + mid term
+ords = np.argsort(-np.asarray(fp.doc_freq))[:3]
+docs = np.sort(np.random.default_rng(0).integers(0, N_DOCS, 4300).astype(np.int64))
+for o in ords:
+    lo, hi = int(fp.post_start[o]), int(fp.post_start[o+1])
+    tdocs = fp.post_doc[lo:hi]
+    t0=time.time()
+    for _ in range(10):
+        j = np.searchsorted(tdocs, docs)
+    t_ss = (time.time()-t0)/10
+    t0=time.time()
+    for _ in range(10):
+        jc = np.minimum(j, len(tdocs)-1); present = (j < len(tdocs)); present &= tdocs[jc] == docs
+    t_gather = (time.time()-t0)/10
+    print(f"df={hi-lo}: searchsorted {t_ss*1000:.2f}ms verify-gather {t_gather*1000:.2f}ms type={type(tdocs).__name__}")
+# compare with in-RAM copy
+o = ords[0]; lo, hi = int(fp.post_start[o]), int(fp.post_start[o+1])
+ram = np.array(fp.post_doc[lo:hi])
+t0=time.time()
+for _ in range(10): np.searchsorted(ram, docs)
+print(f"in-RAM searchsorted {(time.time()-t0)/10*1000:.2f}ms")
+d32 = docs.astype(np.int32)
+o = ords[0]; lo, hi = int(fp.post_start[o]), int(fp.post_start[o+1])
+tdocs = fp.post_doc[lo:hi]
+t0=time.time()
+for _ in range(10): np.searchsorted(tdocs, d32)
+print(f"int32-needles searchsorted {(time.time()-t0)/10*1000:.3f}ms")

@@ -1,0 +1,47 @@
+import os, time, sys
+import numpy as np
+import jax
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+os.environ.setdefault("BENCH_DOCS", "10000000")
+from bench import load_or_build_index, _Seg, N_DOCS, VOCAB, COLD_DF, TURBO_HBM
+from elasticsearch_tpu.parallel import make_mesh
+from elasticsearch_tpu.search.serving import select_bm25_engine
+t0=time.time()
+lens, tokens, fp = load_or_build_index()
+print(f"load {time.time()-t0:.1f}s")
+seg = _Seg(N_DOCS, fp); mesh = make_mesh(1, dp=1)
+t0=time.time()
+eng = select_bm25_engine([seg], "body", None, mesh, hbm_budget_bytes=TURBO_HBM, cold_df=COLD_DF)
+print(f"engine {time.time()-t0:.1f}s kind={eng.kind}")
+t = eng.turbos[0]
+t0=time.time(); n=eng.prebuild_columns(); print(f"prebuild {n} cols {time.time()-t0:.1f}s")
+probs = 1.0 / np.arange(1, VOCAB + 1) ** 1.07; probs /= probs.sum()
+rng = np.random.default_rng(43)
+def draw_batch(n=256):
+    tt = rng.choice(VOCAB, size=(n, 2), p=probs)
+    tt[:, 1] = np.where(tt[:, 1] == tt[:, 0], (tt[:, 1] + 1) % VOCAB, tt[:, 1])
+    return [[f"t{a}", f"t{b}"] for a, b in tt]
+b = draw_batch()
+t0=time.time(); eng.search_many([b], k=10); print(f"warm batch {time.time()-t0:.1f}s")
+
+# instrument: monkeypatch _finish_query and pass2 fetch
+import elasticsearch_tpu.parallel.turbo as T
+orig_finish = T.TurboBM25._finish_query
+stats = {"finish": 0.0, "n": 0, "exact": 0.0, "cold": 0.0}
+orig_exact = T.TurboBM25._exact_scores
+def timed_exact(self, qterms, docs):
+    t1 = time.monotonic(); r = orig_exact(self, qterms, docs)
+    stats["exact"] += time.monotonic()-t1; return r
+def timed_finish(self, terms, cand, bound, k):
+    t1 = time.monotonic(); r = orig_finish(self, terms, cand, bound, k)
+    stats["finish"] += time.monotonic()-t1; stats["n"] += 1; return r
+T.TurboBM25._finish_query = timed_finish
+T.TurboBM25._exact_scores = timed_exact
+for trial in range(3):
+    b2 = draw_batch()
+    stats.update({"finish":0.0,"n":0,"exact":0.0})
+    t0=time.time()
+    eng.search_many([b2], k=10)
+    wall = time.time()-t0
+    print(f"batch: {wall:.2f}s  finish={stats['finish']:.2f}s exact={stats['exact']:.2f}s n={stats['n']} -> {256/wall:.1f} QPS")
