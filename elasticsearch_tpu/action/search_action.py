@@ -190,6 +190,15 @@ class _QueryPhaseResultConsumer:
             self._reserved = 0
         return self.window, self.agg_state
 
+    def release(self) -> None:
+        """Error-path cleanup: drop the pending agg reservation without
+        reducing (ref: QueryPhaseResultConsumer implements Releasable so the
+        breaker bytes never outlive the request)."""
+        if self.breaker is not None and self._reserved:
+            self.breaker.release(self._reserved)
+        self._reserved = 0
+        self._pend_aggs = []
+
 
 class SearchActionService:
     """Shard-level query/fetch handlers + the coordinator entrypoint."""
@@ -346,6 +355,16 @@ class SearchActionService:
 
     # ---------------- coordinator (any node) ----------------
 
+    def _free_contexts(self, shard_results: List[dict]) -> None:
+        """Release the reader contexts a query phase created."""
+        for r in shard_results:
+            try:
+                self.channels.request(
+                    r["_node"], ACTION_FREE,
+                    {"context_id": r["context_id"]})
+            except Exception:  # noqa: BLE001 — reaper collects leftovers
+                pass
+
     def execute_search(self, index_expr: str, body: dict,
                        state: Optional[ClusterState] = None) -> dict:
         """query_then_fetch across every target shard's best copy."""
@@ -416,46 +435,56 @@ class SearchActionService:
             breaker=self.breakers.get_breaker("request"))
         shard_results: List[dict] = []
         failed = 0
-        for node, index, sid in targets:
-            t_q = time.monotonic()
-            try:
-                resp = self.channels.request(
-                    node, ACTION_QUERY,
-                    {"index": index, "shard_id": sid, "body": body})
-                resp["_node"] = node
-                resp["_index"] = index
-                resp["_shard"] = sid
-                shard_results.append(resp)
-                consumer.consume(len(shard_results) - 1, resp)
-                # the consumer owns hit windows + agg partials from here;
-                # drop them from the retained metadata so coordinator
-                # memory stays bounded by the batch size
-                resp["hits"] = ()
-                resp["aggs"] = None
-                took_ms = (time.monotonic() - t_q) * 1000.0
-                prev = self._node_ewma_ms.get(node, took_ms)
-                self._node_ewma_ms[node] = 0.7 * prev + 0.3 * took_ms
-                # age every OTHER node's stat toward zero so a once-bad
-                # node is retried eventually (ref: ResponseCollectorService
-                # adjusts stats for unselected nodes)
-                for other in self._node_ewma_ms:
-                    if other != node:
-                        self._node_ewma_ms[other] *= 0.98
-            except CircuitBreakingError:
-                # a coordinator-side breaker trip is a REQUEST error, not a
-                # shard failure — swallowing it would return silently-wrong
-                # aggregations under memory pressure
-                raise
-            except Exception:  # noqa: BLE001
-                failed += 1
-                # penalize the node so ARS stops preferring a failing copy
-                prev = self._node_ewma_ms.get(node, 0.0)
-                self._node_ewma_ms[node] = 0.7 * prev + 0.3 * 5000.0
+        try:
+            for node, index, sid in targets:
+                t_q = time.monotonic()
+                try:
+                    resp = self.channels.request(
+                        node, ACTION_QUERY,
+                        {"index": index, "shard_id": sid, "body": body})
+                    resp["_node"] = node
+                    resp["_index"] = index
+                    resp["_shard"] = sid
+                    shard_results.append(resp)
+                    consumer.consume(len(shard_results) - 1, resp)
+                    # the consumer owns hit windows + agg partials from here;
+                    # drop them from the retained metadata so coordinator
+                    # memory stays bounded by the batch size
+                    resp["hits"] = ()
+                    resp["aggs"] = None
+                    took_ms = (time.monotonic() - t_q) * 1000.0
+                    prev = self._node_ewma_ms.get(node, took_ms)
+                    self._node_ewma_ms[node] = 0.7 * prev + 0.3 * took_ms
+                    # age every OTHER node's stat toward zero so a once-bad
+                    # node is retried eventually (ref: ResponseCollectorService
+                    # adjusts stats for unselected nodes)
+                    for other in self._node_ewma_ms:
+                        if other != node:
+                            self._node_ewma_ms[other] *= 0.98
+                except CircuitBreakingError:
+                    # a coordinator-side breaker trip is a REQUEST error, not
+                    # a shard failure — swallowing it would return
+                    # silently-wrong aggregations under memory pressure
+                    raise
+                except Exception:  # noqa: BLE001
+                    failed += 1
+                    # penalize the node so ARS stops preferring a failing copy
+                    prev = self._node_ewma_ms.get(node, 0.0)
+                    self._node_ewma_ms[node] = 0.7 * prev + 0.3 * 5000.0
 
-        # ---- reduce (ref: SearchPhaseController.reducedQueryPhase) ----
-        # the incremental consumer already merged/deduped/truncated as
-        # results arrived; finish() folds any remainder
-        window_entries, agg_state = consumer.finish()
+            # ---- reduce (ref: SearchPhaseController.reducedQueryPhase) ----
+            # the incremental consumer already merged/deduped/truncated as
+            # results arrived; finish() folds any remainder
+            window_entries, agg_state = consumer.finish()
+        except BaseException:
+            # breaker trip (or any coordinator error) mid-request: the
+            # consumer's pending agg reservation and every reader context
+            # created so far must not outlive the request — without this the
+            # breaker's _reserved bytes leak until process restart and the
+            # contexts hold segments until the reaper collects them
+            consumer.release()
+            self._free_contexts(shard_results)
+            raise
         total = consumer.total
         relation = consumer.relation
         collapse_field = consumer.collapse
@@ -512,13 +541,7 @@ class SearchActionService:
             suggest_out = _merge_suggests(shard_suggests)
 
         # ---- release contexts ----
-        for r in shard_results:
-            try:
-                self.channels.request(
-                    r["_node"], ACTION_FREE,
-                    {"context_id": r["context_id"]})
-            except Exception:  # noqa: BLE001 — reaper collects leftovers
-                pass
+        self._free_contexts(shard_results)
 
         profile = None
         if body.get("profile"):

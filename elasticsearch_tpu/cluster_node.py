@@ -63,9 +63,17 @@ class ClusterNode:
         self.store = store
         self.transport = transport or TransportService(node_name)
         self.allocation = AllocationService()
+        from elasticsearch_tpu.common.indexing_pressure import IndexingPressure
+
+        # ONE write-backpressure budget per node: the coordinating stage
+        # (bulk fan-out below) and the primary/replica stages inside the
+        # shard service must draw from the same 512MB pool — two separate
+        # IndexingPressure instances would admit twice the bytes
+        # (ref: IndexingPressure.java is a node-level singleton)
+        self.indexing_pressure = IndexingPressure()
         self.shard_service = DistributedShardService(
             node_name, self.transport, channels, self.master_client,
-            data_path)
+            data_path, indexing_pressure=self.indexing_pressure)
         self.applier = IndicesClusterStateService(
             node_name, self.shard_service, self.master_client)
         self.search_action = SearchActionService(
@@ -381,6 +389,15 @@ class ClusterNode:
             sid = shard_for_id(op["id"], n_shards, op.get("routing"))
             by_shard.setdefault(sid, []).append((pos, op))
 
+        # coordinating-stage accounting against the node's ONE shared budget
+        # (ref: TransportBulkAction holds coordinating bytes for the fan-out)
+        with self.indexing_pressure.coordinating(_ops_bytes(ops)):
+            return self._bulk_dispatch(index, ops, by_shard, retries,
+                                       retry_delay)
+
+    def _bulk_dispatch(self, index: str, ops: List[dict],
+                       by_shard: Dict[int, List[Tuple[int, dict]]],
+                       retries: int, retry_delay: float) -> dict:
         results: List[Optional[dict]] = [None] * len(ops)
         errors = False
         for sid, items in by_shard.items():

@@ -76,17 +76,30 @@ class IndexService:
         ClusterBlocks WRITE + IndexMetadata INDEX_WRITE_BLOCK)."""
         self.check_open()
         for key in ("index.blocks.write", "index.blocks.read_only"):
-            if str(self.meta.settings.raw(key, "false")).lower() == "true":
-                from elasticsearch_tpu.common.errors import (
-                    ElasticsearchTpuError,
-                )
+            self._check_block(key, 8)
 
-                err = ElasticsearchTpuError(
-                    f"index [{self.name}] blocked by: [FORBIDDEN/8/"
-                    f"{key} (api)]")
-                err.status = 403
-                err.error_type = "cluster_block_exception"
-                raise err
+    def _check_block(self, key: str, block_id: int) -> None:
+        if str(self.meta.settings.raw(key, "false")).lower() == "true":
+            from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+
+            err = ElasticsearchTpuError(
+                f"index [{self.name}] blocked by: [FORBIDDEN/{block_id}/"
+                f"{key} (api)]")
+            err.status = 403
+            err.error_type = "cluster_block_exception"
+            raise err
+
+    def check_read_allowed(self) -> None:
+        """index.blocks.read rejects get/search/count with 403 (ref:
+        IndexMetadata INDEX_READ_BLOCK, id 7). read_only does NOT block
+        data reads — only writes and metadata writes."""
+        self.check_open()
+        self._check_block("index.blocks.read", 7)
+
+    def check_metadata_allowed(self) -> None:
+        """index.blocks.metadata / read_only reject metadata reads and
+        writes with 403 (ref: IndexMetadata INDEX_METADATA_BLOCK, id 9)."""
+        self._check_block("index.blocks.metadata", 9)
 
     def shard_for(self, doc_id: str, routing: str | None = None) -> InternalEngine:
         return self.shards[shard_for_id(doc_id, len(self.shards), routing)]
@@ -100,7 +113,7 @@ class IndexService:
         return self.shard_for(doc_id, kw.pop("routing", None)).delete(doc_id, **kw)
 
     def get_doc(self, doc_id: str, routing: str | None = None) -> Optional[dict]:
-        self.check_open()
+        self.check_read_allowed()
         return self.shard_for(doc_id, routing).get(doc_id)
 
     def store_size_bytes(self) -> int:
@@ -161,7 +174,7 @@ class IndexService:
                searchers=None, task=None) -> dict:
         import copy as _copy
 
-        self.check_open()
+        self.check_read_allowed()
 
         key = self._request_cache_key(request, search_type)             if searchers is None else None
         if key is not None:
@@ -366,6 +379,7 @@ class IndexService:
 
     def scroll_start(self, request: dict, keep_alive_s: float, registry,
                      task=None) -> dict:
+        self.check_read_allowed()
         searchers = [s.acquire_searcher() for s in self.shards]
         ctx = registry.create(searchers=searchers, mapper=self.mapper,
                               index=self.name, keep_alive_s=keep_alive_s)

@@ -82,6 +82,29 @@ class FieldPostings:
         return self.pos_data[int(self.pos_start[p]): int(self.pos_start[p + 1])]
 
 
+def tf_at(fp: "FieldPostings", term: str,
+          docs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(tf f32[n], present bool[n]) of `term` for sorted candidate docs.
+
+    SHARED by the serving conjunctive reference (search/serving.py) and
+    the TurboBM25 bool rescore (parallel/turbo.py): both sides computing
+    tf through this one function is what keeps their scores bit-identical.
+    """
+    o = fp.term_to_ord.get(term)
+    if o is None:
+        return np.zeros(len(docs), np.float32), np.zeros(len(docs), bool)
+    lo, hi = int(fp.post_start[o]), int(fp.post_start[o + 1])
+    seg = fp.post_doc[lo:hi]
+    j = np.searchsorted(seg, docs)
+    present = (j < hi - lo)
+    present[present] = seg[j[present]] == docs[present]
+    within = np.where(present, j, 0).astype(np.int64)
+    row = int(fp.block_start[o]) + within // 128
+    lane = within % 128
+    tf = fp.block_tfs[row, lane].astype(np.float32)
+    return np.where(present, tf, 0.0), present
+
+
 @dataclass
 class NumericColumn:
     values: np.ndarray                  # [n_docs] f64 (min value; asc sort mode)

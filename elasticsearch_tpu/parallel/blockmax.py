@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elasticsearch_tpu.parallel.compat import SHARD_MAP_RETRACE_SAFE, shard_map
 from elasticsearch_tpu.ops import bm25_idf, next_bucket
 from elasticsearch_tpu.parallel.spmd import (
     B, K1, StackedBM25, _dense_topk_tiebreak, _gather_parts, _merge_gathered,
@@ -375,8 +376,12 @@ class BlockMaxBM25:
         t1 = _time.monotonic()
         timing["assemble_a"] = t1 - t0
         # one transfer: theta for every query
-        thetas = np.asarray(jnp.concatenate(
-            [p[:n, 0, k - 1] for p, n in a_packed]))[: len(flat)]
+        if SHARD_MAP_RETRACE_SAFE:
+            thetas = np.asarray(jnp.concatenate(
+                [p[:n, 0, k - 1] for p, n in a_packed]))[: len(flat)]
+        else:  # legacy shard_map: fetch per program, combine on host
+            thetas = np.concatenate(
+                [np.asarray(p)[:n, 0, k - 1] for p, n in a_packed])[: len(flat)]
         t2 = _time.monotonic()
         timing["theta_fetch"] = t2 - t1
 
@@ -455,8 +460,13 @@ class BlockMaxBM25:
         # one transfer: all groups' packed results (flattened; ragged shapes)
         out_all = np.zeros((len(flat), 3, k), np.float32)
         if pending:
-            flat_out = np.asarray(jnp.concatenate(
-                [p.reshape(-1, 3 * k) for _, p in pending], axis=0))
+            if SHARD_MAP_RETRACE_SAFE:
+                flat_out = np.asarray(jnp.concatenate(
+                    [p.reshape(-1, 3 * k) for _, p in pending], axis=0))
+            else:  # legacy shard_map: fetch per program, combine on host
+                flat_out = np.concatenate(
+                    [np.asarray(p).reshape(-1, 3 * k) for _, p in pending],
+                    axis=0)
             row = 0
             for idxs, p in pending:
                 n_rows = p.shape[0]
@@ -924,7 +934,7 @@ def _scatter_chunk(block_docs, block_scores, acc, qb, qw, *, mesh):
     they contribute nothing (block 0's lanes get +0)."""
 
     @partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("shard")),
         out_specs=P("shard"), check_vma=False)
     def program(bd, bs, acc, qb, qw):
@@ -945,7 +955,7 @@ def _acc_topk(acc, hot_cols, live, W, *, mesh, k):
     _one_query_topk: live and (some sparse lane or some hot contribution))."""
 
     @partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P()),
         out_specs=P(), check_vma=False)
     def program(acc, hc, lv, W):
@@ -1018,7 +1028,7 @@ def _bool_program(block_docs, block_scores, live, hot_cols, W, Wp, qb, qi, qf,
     [Q,3,k]."""
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                   P("dp"), P("dp"), P("dp", "shard"), P("dp", "shard"),
@@ -1071,7 +1081,7 @@ def _hybrid_program(block_docs, block_scores, live, hot_cols, W, qblocks, qidf,
     """
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                   P("dp"), P("dp", "shard"), P("dp", "shard")),
@@ -1115,7 +1125,7 @@ def _lane_program(block_docs, block_scores, live, qblocks, qidf, *, mesh, k):
     this removes the dominant O(Qc*D) term from most dispatches."""
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"),
                   P("dp", "shard"), P("dp", "shard")),

@@ -1,0 +1,35 @@
+"""Shims over jax API renames so one source tree runs on old and new jax.
+
+The package is written against the current public names (``jax.shard_map``
+with ``check_vma``, ``pltpu.CompilerParams``); older jax releases ship the
+same functionality as ``jax.experimental.shard_map.shard_map`` (where
+``check_vma`` is spelled ``check_rep``) and ``pltpu.TPUCompilerParams``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+    # New shard_map outputs are safe to feed back into traced ops.
+    SHARD_MAP_RETRACE_SAFE = True
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma)
+
+    # With check_rep=False, a legacy shard_map output whose out_specs leave a
+    # mesh axis unmentioned is carried as UNREDUCED partial sums: np.asarray
+    # fetches one replica (correct), but feeding the array into any traced op
+    # (reshape/concatenate/slice under jit) folds in a spurious psum over the
+    # unmentioned axes — values come back multiplied by the axis size.
+    # Callers must fetch such outputs to host before combining them.
+    SHARD_MAP_RETRACE_SAFE = False
+
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams

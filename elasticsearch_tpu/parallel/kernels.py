@@ -38,6 +38,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from elasticsearch_tpu.parallel.compat import CompilerParams as _CompilerParams
+
 SW = 65536            # docs per superwindow (candidate granularity)
 TILE = 16384          # docs per build tile (outer-product target)
 SW_ROWS = SW // 128   # 512
@@ -171,11 +173,139 @@ def sweep_rowmax(qscale, cols_hi, cols_lo, wq, live, *, QC: int, nsw: int):
             jax.ShapeDtypeStruct((nsw, QC, CAND_PAD), jnp.float32),
             jax.ShapeDtypeStruct((nsw, QC, CAND_PAD), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=_interpret(),
     )
     return fn(qscale, cols_hi, cols_lo, wq, live)
+
+
+def _sweep_conj_kernel(QC: int, Hpt: int):
+    def kernel(qscale, nreq, hi_blk, lo_blk, wq, wp, live_blk,
+               out_m, out_r, acc_rm):
+        c = pl.program_id(1)
+        sw = pl.program_id(0)
+
+        wh = wq[0]                                        # [QC, Hpt] i8
+        wl = wq[1]
+        ch = hi_blk[0]                                    # [Hpt, 16, 128] i8
+        cl = lo_blk[0]
+        dn = (((1,), (0,)), ((), ()))
+        m_hh = jax.lax.dot_general(wh, ch, dn,
+                                   preferred_element_type=jnp.int32)
+        m_hl = jax.lax.dot_general(wh, cl, dn,
+                                   preferred_element_type=jnp.int32)
+        m_lh = jax.lax.dot_general(wl, ch, dn,
+                                   preferred_element_type=jnp.int32)
+        m_ll = jax.lax.dot_general(wl, cl, dn,
+                                   preferred_element_type=jnp.int32)
+        val = (16384.0 * m_hh.astype(jnp.float32)
+               + 128.0 * (m_hl + m_lh).astype(jnp.float32)
+               + m_ll.astype(jnp.float32))                # [QC, 16, 128]
+        val = val * qscale[...][:, :, None]
+        # conjunction as one extra matmul: presence = term occurs at doc
+        # (the build kernel guarantees (hi, lo) != 0 exactly there), so
+        # coverage == n_req iff every required clause is present and no
+        # must_not clause is (must_not slots carry weight -(n_req + 1))
+        present = ((ch != 0) | (cl != 0)).astype(jnp.int8)
+        cov = jax.lax.dot_general(wp[...], present, dn,
+                                  preferred_element_type=jnp.int32)
+        lv = live_blk[...]                                # [16, 128] f32
+        ok = (lv[None] > 0) & (val > 0) & (cov == nreq[...][:, :, None])
+        val = jnp.where(ok, val, -jnp.inf)
+        acc_rm[pl.ds(c, 1), :, :] = jnp.transpose(
+            jnp.max(val, axis=2))[None]
+
+        @pl.when(c == N_CHUNKS - 1)
+        def _toprows():
+            rm = acc_rm[...]                              # [32, 16, QC]
+            rows3 = (jax.lax.broadcasted_iota(
+                        jnp.int32, (N_CHUNKS, CHUNK_ROWS, QC), 0)
+                     * CHUNK_ROWS
+                     + jax.lax.broadcasted_iota(
+                        jnp.int32, (N_CHUNKS, CHUNK_ROWS, QC), 1))
+            big = jnp.int32(1 << 30)
+            cand_iota = jax.lax.broadcasted_iota(
+                jnp.int32, (CAND_PAD, QC), 0)
+            all_m = jnp.full((CAND_PAD, QC), -jnp.inf, jnp.float32)
+            all_r = jnp.zeros((CAND_PAD, QC), jnp.int32)
+            for p in range(NCAND):
+                m2 = jnp.max(jnp.max(rm, axis=0), axis=0,
+                             keepdims=True)               # [1, QC]
+                at = rm == m2[None]
+                rmin = jnp.min(jnp.min(jnp.where(at, rows3, big), axis=0),
+                               axis=0, keepdims=True)     # [1, QC]
+                keep = (cand_iota == p) & (m2 > -jnp.inf)
+                all_m = jnp.where(keep, m2, all_m)
+                all_r = jnp.where(keep, rmin + sw * SW_ROWS, all_r)
+                rm = jnp.where(rows3 == rmin[None], -jnp.inf, rm)
+            out_m[0, :, :] = jnp.transpose(all_m)
+            out_r[0, :, :] = jnp.transpose(all_r)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("QC", "nsw"))
+def sweep_rowmax_conj(qscale, nreq, cols_hi, cols_lo, wq, wp, live,
+                      *, QC: int, nsw: int):
+    """Conjunctive variant of sweep_rowmax: identical score sweep, plus a
+    coverage matmul over a per-chunk presence matrix that zeroes (to -inf)
+    every doc not satisfying the query's required clauses.
+
+    nreq [QC, 1] i32 — required-clause count per query
+    wp   [QC, Hpt] i8 — +1 on each required slot (must / filter / slop-0
+         phrase columns), -(n_req + 1) on each must_not slot, 0 elsewhere
+
+    A doc survives iff sum(wp[slot] * present[slot, doc]) == n_req: every
+    required column nonzero there and no must_not column nonzero (one
+    must_not presence drags the sum below zero, unreachable by the +1s).
+    Returns the same (rowmax, rows) pair as sweep_rowmax, now bounding
+    only docs that satisfy the conjunction.
+    """
+    Hpt = cols_hi.shape[1]
+    kernel = _sweep_conj_kernel(QC, Hpt)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(nsw, N_CHUNKS),
+        in_specs=[
+            pl.BlockSpec((QC, 1), lambda sw, c: (0, 0),
+                         memory_space=pltpu.VMEM),        # qscale
+            pl.BlockSpec((QC, 1), lambda sw, c: (0, 0),
+                         memory_space=pltpu.VMEM),        # nreq
+            pl.BlockSpec((1, Hpt, CHUNK_ROWS, 128),
+                         lambda sw, c: (sw * N_CHUNKS + c, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Hpt, CHUNK_ROWS, 128),
+                         lambda sw, c: (sw * N_CHUNKS + c, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),        # wq
+            pl.BlockSpec(memory_space=pltpu.VMEM),        # wp
+            pl.BlockSpec((CHUNK_ROWS, 128),
+                         lambda sw, c: (sw * N_CHUNKS + c, 0),
+                         memory_space=pltpu.VMEM),        # live chunk
+        ],
+        out_specs=[
+            pl.BlockSpec((1, QC, CAND_PAD), lambda sw, c: (sw, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, QC, CAND_PAD), lambda sw, c: (sw, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((N_CHUNKS, CHUNK_ROWS, QC), jnp.float32),  # acc_rm
+        ],
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((nsw, QC, CAND_PAD), jnp.float32),
+            jax.ShapeDtypeStruct((nsw, QC, CAND_PAD), jnp.int32),
+        ],
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )
+    return fn(qscale, nreq, cols_hi, cols_lo, wq, wp, live)
 
 
 ROWS_PER_STEP = 8
@@ -223,6 +353,11 @@ def _build_kernel():
         hi_t = jnp.clip(jnp.round(tacc * (1.0 / COLSCALE)), -127, 127)
         lo_t = jnp.clip(jnp.round(
             (tacc - hi_t * COLSCALE) * (1.0 / COLSCALE2)), -127, 127)
+        # presence exactness: a cell with a real posting (tacc > 0) must
+        # stay nonzero in (hi, lo) so the conjunctive sweep's presence mask
+        # sees it; the per-term certificate error widens from half a lo
+        # step to a full one to cover the forced value (turbo.py e_q)
+        lo_t = jnp.where((tacc > 0) & (hi_t == 0) & (lo_t == 0), 1.0, lo_t)
         hi8 = hi_t.astype(jnp.int8)
         lo8 = lo_t.astype(jnp.int8)
         for u in range(TILE // 2048):                     # 8 chunk-majors

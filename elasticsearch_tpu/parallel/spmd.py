@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elasticsearch_tpu.parallel.compat import shard_map
 from elasticsearch_tpu.index.segment import FieldPostings, Segment
 from elasticsearch_tpu.ops import BLOCK, bm25_idf, next_bucket
 
@@ -430,7 +431,7 @@ def _bm25_program(block_docs, block_tfs, doc_len, live, qb, qi, avgdl, *, mesh, 
     """Compiled once per (mesh, k, shapes): the flagship distributed program."""
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"),
                   P("dp", "shard"), P("dp", "shard"), P()),
@@ -509,7 +510,7 @@ def sharded_bm25_topk(
 @partial(jax.jit, static_argnames=("mesh", "k", "similarity"))
 def _knn_program(vectors_a, norms_a, exists_a, live_a, queries_a, *, mesh, k, similarity):
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P("dp")),
         out_specs=(P("dp"), P("dp"), P("dp")),
@@ -582,7 +583,7 @@ def _column_insert_program(cache, block_docs, block_scores, blks, slots, mesh):
     """
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("shard"), P("shard"), P()),
         out_specs=P("shard"),
@@ -615,7 +616,7 @@ def _column_score_program(cache, live, qpacked, mesh, k):
     C1 = cache.shape[1]
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(P("shard"), P("shard"), P("dp")),
         out_specs=P("dp"),

@@ -47,17 +47,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from elasticsearch_tpu.index.positions import phrase_freqs
+from elasticsearch_tpu.index.segment import tf_at
 from elasticsearch_tpu.ops import bm25_idf
 from elasticsearch_tpu.parallel.blockmax import _host_block_scores
 from elasticsearch_tpu.parallel.kernels import (
     COLSCALE, COLSCALE2, MAX_GROUP_ROWS, NCAND, ROWS_PER_STEP,
-    SW, TILE, build_columns, sweep_rowmax,
+    SW, TILE, build_columns, sweep_rowmax, sweep_rowmax_conj,
 )
 from elasticsearch_tpu.parallel.spmd import StackedBM25
 
 COLD_DF = 16384        # below this, terms are host-scored
 K1_PLUS1 = 2.2         # BM25 idf-free impact upper bound
+_K1 = 1.2              # BM25 k1 (must equal serving.K1)
+_B = 0.75              # BM25 b  (must equal serving.B)
 _GLOBAL_ROWS = 33      # candidate posting rows collected per query
+_MAX_REQ = 126         # coverage counts fit int8 with the must_not weight
 
 from functools import partial as _partial  # noqa: E402
 
@@ -108,6 +113,18 @@ def _bucket(n: int) -> int:
     return _BUILD_BUCKETS[-1]
 
 
+_ROW_BUCKETS = (256, 2048, 16384)   # synthetic phrase-lane row counts are
+#   bucketed so build_columns sees a bounded set of lane shapes (each new
+#   shape is a fresh jit trace)
+
+
+def _row_bucket(n: int) -> int:
+    for b in _ROW_BUCKETS:
+        if n <= b:
+            return b
+    return -(-n // _ROW_BUCKETS[-1]) * _ROW_BUCKETS[-1]
+
+
 @dataclass
 class _TermInfo:
     ord: int
@@ -116,6 +133,38 @@ class _TermInfo:
     row_start: int          # first block row
     n_rows: int             # block rows
     smax: float             # max idf-free lane score
+
+
+@dataclass
+class _PhraseInfo:
+    """Metadata for a slop-0 phrase treated as a synthetic term: its
+    matching docs and per-doc phrase freqs (computed once at column-build
+    time by a positions-delta check, index/positions.phrase_freqs) back
+    both the int8 adjacency column build and the exact host rescore."""
+    key: str                # column-cache key ("\x00p:" + joined terms)
+    terms: Tuple[str, ...]
+    docs: np.ndarray        # i32 ascending, live-unfiltered
+    pf: np.ndarray          # f32 phrase freqs aligned with docs
+    idf_sum: float          # sum of member-term idfs, in term order
+    smax: float             # max idf-free phrase lane score
+
+
+def _pkey(terms: Sequence[str]) -> str:
+    return "\x00p:" + "\x00".join(terms)
+
+
+@dataclass
+class _BoolQuery:
+    """One resolved bool query (TurboBM25.search_bool). Clause lists keep
+    the ORIGINAL spec order — the exact rescore iterates them verbatim so
+    its f64 accumulation is bit-identical to the serving reference
+    (search/serving._conjunctive_partition)."""
+    conj: list        # [(term, boost, _TermInfo)] — required, scoring
+    should: list      # [(term, boost, _TermInfo)] — optional, scoring
+    filters: list     # [(term, _TermInfo)] — required, non-scoring
+    must_not: list    # [(term, _TermInfo)] — prohibited
+    phrases: list     # [(terms, slop, boost, _PhraseInfo | None, idf_sum)]
+    dev_candidate: bool
 
 
 class TurboBM25:
@@ -199,8 +248,15 @@ class TurboBM25:
         self._pending_zero: List[tuple] = []
         self._tick = 0
         self._terms: Dict[str, Optional[_TermInfo]] = {}
+        self._phrases: Dict[str, Optional[_PhraseInfo]] = {}
+        # per-cache-key tile bases touched by the key's build groups, kept
+        # so eviction can zero exactly those tiles even for keys (phrases)
+        # whose lane arrays are long gone
+        self._tile_bases: Dict[str, np.ndarray] = {}
+        self.force_cert_fail = False   # test hook: exercise the fallback
         self.stats = {"builds": 0, "build_s": 0.0, "fallbacks": 0,
-                      "cold_queries": 0, "dispatches": 0, "degraded": 0}
+                      "cold_queries": 0, "dispatches": 0, "degraded": 0,
+                      "phrase_builds": 0, "bool_host": 0, "bool_device": 0}
 
     # ---------------- term metadata ----------------
 
@@ -242,7 +298,28 @@ class TurboBM25:
                 (tiles[keep] * TILE).astype(np.int32),
                 np.full(int(keep.sum()), slot, np.int32))
 
-    def ensure_columns(self, terms: Sequence[str]) -> None:
+    def _evict(self, key: str) -> None:
+        slot = self._slot_of.pop(key)
+        del self._lru[key]
+        self._free.append(slot)
+        # zero the evicted key's touched tiles so the reused slot carries
+        # no phantom scores. Rows are pinned to 0 (n = 0 groups DMA rows
+        # [0, MAX_GROUP_ROWS) and write nothing) so these groups can ride
+        # along ANY later build dispatch regardless of its lane arrays —
+        # phrase builds use synthetic lane arrays where a term's row ids
+        # would be out of bounds.
+        bases = self._tile_bases.pop(key, None)
+        if bases is not None and len(bases):
+            z = np.zeros(len(bases), np.int32)
+            self._pending_zero.append(
+                (z, z, bases, np.full(len(bases), slot, np.int32)))
+        if key.startswith("\x00p:"):
+            # phrase metadata carries the (docs, pf) arrays — drop them
+            # with the column, recompute if the phrase is colized again
+            self._phrases.pop(key, None)
+
+    def ensure_columns(self, terms: Sequence[str],
+                       protect_extra: Sequence[str] = ()) -> None:
         self._tick += 1
         need: List[_TermInfo] = []
         for t in dict.fromkeys(terms):
@@ -255,7 +332,7 @@ class TurboBM25:
             need.append((t, info))
         if not need:
             return
-        protect = set(t for t, _ in need) | set(terms)
+        protect = set(t for t, _ in need) | set(terms) | set(protect_extra)
         deficit = len(need) - len(self._free)
         if deficit > 0:
             victims = [t for t in sorted(self._lru, key=self._lru.get)
@@ -270,16 +347,7 @@ class TurboBM25:
                 self.stats["degraded"] += len(need) - capacity
                 need = need[:capacity]
             for v in victims:
-                slot = self._slot_of.pop(v)
-                del self._lru[v]
-                self._free.append(slot)
-                # zero the evicted term's tiles so the reused slot carries
-                # no phantom scores (only its touched tiles need clearing)
-                vinfo = self._terms.get(v)
-                if vinfo is not None:
-                    r, n, b, s = self._term_groups(vinfo, slot)
-                    self._pending_zero.append(
-                        (r, np.zeros_like(n), b, s))
+                self._evict(v)
         rows_l, n_l, base_l, slot_l = [], [], [], []
         for r, n, b, s in self._pending_zero:
             rows_l.append(r); n_l.append(n); base_l.append(b); slot_l.append(s)
@@ -293,6 +361,7 @@ class TurboBM25:
             self._slot_of[t] = slot
             self._lru[t] = self._tick
             r, n, b, s = self._term_groups(info, slot)
+            self._tile_bases[t] = b
             rows_l.append(r); n_l.append(n); base_l.append(b); slot_l.append(s)
         rows = np.concatenate(rows_l)
         nrows = np.concatenate(n_l)
@@ -314,6 +383,146 @@ class TurboBM25:
                 self.lane_docs, self.lane_scores,
                 self.cols_hi, self.cols_lo, n_groups=ng)
         self.stats["builds"] += len(need)
+        self.stats["build_s"] += time.monotonic() - t0
+
+    # ---------------- phrase columns ----------------
+
+    def _phrase(self, terms: Sequence[str]) -> Optional[_PhraseInfo]:
+        """Metadata for a slop-0 phrase (cached; None if a member term is
+        missing from this partition). The full-corpus positions-delta scan
+        runs once per phrase; its (docs, pf) arrays then back both the
+        adjacency-column build and the exact host rescore."""
+        terms = tuple(terms)
+        key = _pkey(terms)
+        if key in self._phrases:
+            return self._phrases[key]
+        infos = [self._term(t) for t in terms]
+        if any(i is None for i in infos):
+            self._phrases[key] = None
+            return None
+        docs, pf = phrase_freqs(self.fp, list(terms), slop=0)
+        docs = np.asarray(docs, np.int32)
+        pf = np.asarray(pf, np.float32)
+        # idf-free phrase lane scores: same shape as a term's BM25 lane
+        # score with tf := phrase freq, so the K1_PLUS1 impact bound and
+        # the COLSCALE int8 quantization both hold unchanged
+        smax = 0.0
+        if len(docs):
+            dl = self.fp.doc_len[docs]
+            denom = pf + _K1 * (1.0 - _B + _B * dl / max(self._avgdl, 1e-9))
+            smax = float((pf * (_K1 + 1.0) / denom).max())
+        info = _PhraseInfo(
+            key=key, terms=terms, docs=docs, pf=pf,
+            idf_sum=float(sum(i.idf for i in infos)), smax=smax)
+        self._phrases[key] = info
+        return info
+
+    def _phrase_lane(self, info: _PhraseInfo) -> np.ndarray:
+        """f32 idf-free lane scores aligned with info.docs."""
+        dl = self.fp.doc_len[info.docs]
+        denom = info.pf + _K1 * (1.0 - _B + _B * dl
+                                 / max(self._avgdl, 1e-9))
+        return (info.pf * (_K1 + 1.0) / denom).astype(np.float32)
+
+    def ensure_phrases(self, phrase_lists: Sequence[Sequence[str]],
+                       protect_extra: Sequence[str] = ()) -> None:
+        """Colize slop-0 phrases: pack each phrase's (docs, lane score)
+        pairs into synthetic 128-wide lane arrays and run them through the
+        SAME build_columns outer-product kernel and LRU slot pool as term
+        columns. Eviction/zeroing discipline is shared (_evict)."""
+        self._tick += 1
+        need: List[_PhraseInfo] = []
+        for terms in dict.fromkeys(tuple(p) for p in phrase_lists):
+            info = self._phrase(terms)
+            if info is None or not len(info.docs):
+                continue
+            if info.key in self._slot_of:
+                self._lru[info.key] = self._tick
+                continue
+            need.append(info)
+        if not need:
+            return
+        protect = {i.key for i in need} | set(protect_extra)
+        deficit = len(need) - len(self._free)
+        if deficit > 0:
+            victims = [t for t in sorted(self._lru, key=self._lru.get)
+                       if t not in protect][:deficit]
+            if len(victims) < deficit:
+                # capacity overflow: grant the highest-df phrases (whose
+                # host intersections are the most expensive to run) and
+                # leave the rest for the exact host path this batch
+                capacity = len(self._free) + len(victims)
+                need.sort(key=lambda pi: -len(pi.docs))
+                self.stats["degraded"] += len(need) - capacity
+                need = need[:capacity]
+            for v in victims:
+                self._evict(v)
+        rows_l, n_l, base_l, slot_l = [], [], [], []
+        for r, n, b, s in self._pending_zero:
+            rows_l.append(r); n_l.append(n); base_l.append(b); slot_l.append(s)
+        self._pending_zero = []
+        if not need and not rows_l:
+            # full degradation (every slot protected, nothing evictable,
+            # no zeroing pending): nothing to dispatch
+            return
+        drows, dvals = [], []
+        cursor = 0
+        for info in need:
+            slot = self._free.pop()
+            self._slot_of[info.key] = slot
+            self._lru[info.key] = self._tick
+            lane = self._phrase_lane(info)
+            nr = -(-len(info.docs) // 128)
+            d2 = np.zeros((nr, 128), np.int32)
+            v2 = np.zeros((nr, 128), np.float32)
+            d2.ravel()[: len(info.docs)] = info.docs
+            v2.ravel()[: len(info.docs)] = lane
+            # tile partitioning mirrors _term_groups over the synthetic
+            # rows; docs are ascending so row lo/hi are monotone (trailing
+            # zero pad lanes keep the row max the true last doc)
+            lo = d2[:, 0].astype(np.int64)
+            hi = d2.max(axis=1).astype(np.int64)
+            t0, t1 = int(lo[0]) // TILE, int(hi[-1]) // TILE
+            tiles = np.arange(t0, t1 + 1, dtype=np.int64)
+            starts = np.searchsorted(hi, tiles * TILE, side="left")
+            ends = np.searchsorted(lo, (tiles + 1) * TILE, side="left")
+            ng = (ends - starts).astype(np.int32)
+            keep = ng > 0
+            bases = (tiles[keep] * TILE).astype(np.int32)
+            rows_l.append(cursor + starts[keep].astype(np.int32))
+            n_l.append(ng[keep])
+            base_l.append(bases)
+            slot_l.append(np.full(int(keep.sum()), slot, np.int32))
+            self._tile_bases[info.key] = bases
+            drows.append(d2); dvals.append(v2)
+            cursor += nr
+        # trailing DMA pad + row-count bucketing (bounded jit traces)
+        pad_rows = _row_bucket(cursor) + MAX_GROUP_ROWS - cursor
+        drows.append(np.zeros((pad_rows, 128), np.int32))
+        dvals.append(np.zeros((pad_rows, 128), np.float32))
+        lane_docs = jnp.asarray(np.concatenate(drows, axis=0))
+        lane_scores = jnp.asarray(np.concatenate(dvals, axis=0))
+        rows = np.concatenate(rows_l)
+        nrows = np.concatenate(n_l)
+        bases = np.concatenate(base_l)
+        slots = np.concatenate(slot_l)
+        t0 = time.monotonic()
+        for off in range(0, len(rows), _BUILD_BUCKETS[-1]):
+            part = slice(off, off + _BUILD_BUCKETS[-1])
+            r_p, n_p, b_p, s_p = (rows[part], nrows[part],
+                                  bases[part], slots[part])
+            ng = _bucket(len(r_p))
+            pad = ng - len(r_p)
+            self.cols_hi, self.cols_lo = build_columns(
+                jnp.asarray(np.concatenate([r_p, np.zeros(pad, np.int32)])),
+                jnp.asarray(np.concatenate([n_p, np.zeros(pad, np.int32)])),
+                jnp.asarray(np.concatenate([b_p, np.zeros(pad, np.int32)])),
+                jnp.asarray(np.concatenate(
+                    [s_p, np.full(pad, self.Hp, np.int32)])),
+                lane_docs, lane_scores,
+                self.cols_hi, self.cols_lo, n_groups=ng)
+        self.stats["builds"] += len(need)
+        self.stats["phrase_builds"] += len(need)
         self.stats["build_s"] += time.monotonic() - t0
 
     def _cold_contrib(self, cold_terms):
@@ -541,8 +750,11 @@ class TurboBM25:
                 wh = max(-127, min(127, round(w / qs)))
                 wl = max(-127, min(127, round((w - qs * wh) / qs2)))
                 w_approx = qs * wh + qs2 * wl
+                # a full lo step (not half): the build kernel forces
+                # lo >= 1 on presence-only cells so the conjunctive
+                # sweep's presence mask stays exact (kernels._build_kernel)
                 e_q += (abs(w - w_approx) * K1_PLUS1
-                        + abs(w_approx) * COLSCALE2 / 2.0)
+                        + abs(w_approx) * COLSCALE2)
             # f32 rounding of the in-kernel integer combine
             e_q += 3e-7 * sum(abs(w) for w in ws) * K1_PLUS1
         e_q = float(e_q)
@@ -614,3 +826,432 @@ class TurboBM25:
                     return self.fallback(terms, k)
                 return self._exact_merge(qterms, k)
         return out_s, out_d
+
+    # ---------------- bool / phrase search ----------------
+    #
+    # The conjunctive sweep scores with the SAME int8 columns as the
+    # disjunctive one but multiplies in a presence mask: a doc survives
+    # only if every required slot's column is nonzero there (and no
+    # resident must_not slot's is). Presence is EXACT because the build
+    # kernel forces lo >= 1 on presence-only cells, so the device-side
+    # conjunction/filtering never needs host verification — only scores
+    # do, and the host rescores every collected doc exactly, with the
+    # certificate bounding uncollected rows just like the disjunctive
+    # path. Cold SHOULD terms ride the _cold_contrib enumeration; cold
+    # REQUIRED clauses route the whole query to the exact host path
+    # (complete: every match lies inside the rarest required clause's
+    # postings, so no certificate is needed there).
+
+    def _resolve_bool(self, spec: dict) -> Optional[_BoolQuery]:
+        """Resolve one bool spec; None means provably zero matches.
+
+        spec keys (all optional): "must"/"should" [(term, boost)],
+        "filter"/"must_not" [term], "phrases" [(terms, slop, boost)]."""
+        conj, should, filters, must_not, phrases = [], [], [], [], []
+        for t, b in spec.get("must", ()):
+            info = self._term(t)
+            if info is None:
+                return None
+            conj.append((t, float(b), info))
+        for t in spec.get("filter", ()):
+            info = self._term(t)
+            if info is None:
+                return None
+            filters.append((t, info))
+        for t, b in spec.get("should", ()):
+            info = self._term(t)
+            if info is not None:
+                should.append((t, float(b), info))
+        req_names = {t for t, _, _ in conj} | {t for t, _ in filters}
+        for t in spec.get("must_not", ()):
+            if t in req_names:
+                return None          # required AND prohibited
+            info = self._term(t)
+            if info is not None:
+                must_not.append((t, info))
+        phrase_specs = [(tuple(p[0]), int(p[1]), float(p[2]))
+                        for p in spec.get("phrases", ())]
+        req_infos = [i for _, _, i in conj] + [i for _, i in filters]
+        dev = (all(i.df >= self.cold_df for i in req_infos)
+               and all(s == 0 for _, s, _ in phrase_specs)
+               and len(req_infos) + len(phrase_specs) <= _MAX_REQ
+               and bool(any(b != 0.0 for _, b, _ in conj) or should
+                        or any(b != 0.0 for _, _, b in phrase_specs)))
+        for terms, slop, boost in phrase_specs:
+            infos = [self._term(t) for t in terms]
+            if any(i is None for i in infos):
+                return None          # phrase term absent: no phrase match
+            idf_sum = float(sum(i.idf for i in infos))
+            pinfo = None
+            if slop == 0 and (dev or
+                              self._phrases.get(_pkey(terms)) is not None):
+                # resolve the full-corpus phrase scan only for queries
+                # headed to the device (host-routed ones verify positions
+                # docs_filter'd to the term intersection instead)
+                pinfo = self._phrase(terms)
+                if pinfo is None or not len(pinfo.docs):
+                    return None      # required phrase matches nothing
+            phrases.append((terms, slop, boost, pinfo, idf_sum))
+        return _BoolQuery(conj=conj, should=should, filters=filters,
+                          must_not=must_not, phrases=phrases,
+                          dev_candidate=dev)
+
+    def _bool_resident(self, r: _BoolQuery) -> bool:
+        for t, _, _ in r.conj:
+            if t not in self._slot_of:
+                return False
+        for t, _ in r.filters:
+            if t not in self._slot_of:
+                return False
+        for terms, _, _, pinfo, _ in r.phrases:
+            if pinfo is None or pinfo.key not in self._slot_of:
+                return False
+        return True
+
+    def _bool_slots(self, r: _BoolQuery):
+        """(scoring [(slot, w, smax)], required slots, must_not slots)
+        over columns resident NOW — the single source of what _sweep_bool
+        quantizes, reused by _finish_bool so the certificate's e_q mirrors
+        the dispatched weights exactly."""
+        ws: Dict[int, float] = {}
+        smax: Dict[int, float] = {}
+        req = set()
+        for t, b, info in r.conj:
+            slot = self._slot_of.get(t)
+            if slot is None:
+                continue
+            ws[slot] = ws.get(slot, 0.0) + info.idf * b
+            smax[slot] = info.smax
+            req.add(slot)
+        for t, info in r.filters:
+            slot = self._slot_of.get(t)
+            if slot is not None:
+                req.add(slot)
+        for t, b, info in r.should:
+            slot = self._slot_of.get(t)
+            if slot is not None:
+                ws[slot] = ws.get(slot, 0.0) + info.idf * b
+                smax[slot] = info.smax
+        for terms, _, boost, pinfo, idf_sum in r.phrases:
+            if pinfo is None:
+                continue
+            slot = self._slot_of.get(pinfo.key)
+            if slot is not None:
+                ws[slot] = ws.get(slot, 0.0) + idf_sum * boost
+                smax[slot] = pinfo.smax
+                req.add(slot)
+        mn = set()
+        for t, info in r.must_not:
+            slot = self._slot_of.get(t)
+            if slot is not None and slot not in req:
+                mn.add(slot)
+        scoring = [(s, w, smax[s]) for s, w in ws.items() if w != 0.0]
+        return scoring, req, mn
+
+    def _sweep_bool(self, chunk: Sequence[_BoolQuery], QC: int):
+        wq = np.zeros((2, QC, self.Hp + 1), np.int8)
+        wp = np.zeros((QC, self.Hp + 1), np.int8)
+        nreq = np.zeros((QC, 1), np.int32)
+        qscale = np.ones((QC, 1), np.float32)
+        for qi, r in enumerate(chunk):
+            scoring, req, mn = self._bool_slots(r)
+            nreq[qi, 0] = len(req)
+            for s in req:
+                wp[qi, s] = 1
+            for s in mn:
+                # one prohibited presence pushes the coverage sum below 0,
+                # unreachable by any subset of +1 weights (n_req <= 126
+                # keeps this in int8)
+                wp[qi, s] = np.int8(-(len(req) + 1))
+            if not scoring:
+                continue
+            wmax = max(abs(w) for _, w, _ in scoring)
+            qs = max(wmax / 127.0, 1e-9)
+            qs2 = qs / 128.0
+            qscale[qi, 0] = qs2 * COLSCALE2
+            for slot, w, _ in scoring:
+                wh = max(-127, min(127, round(w / qs)))
+                wl = max(-127, min(127, round((w - qs * wh) / qs2)))
+                wq[0, qi, slot] = np.int8(wh)
+                wq[1, qi, slot] = np.int8(wl)
+        return sweep_rowmax_conj(
+            jnp.asarray(qscale), jnp.asarray(nreq), self.cols_hi,
+            self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
+            QC=QC, nsw=self.nsw)
+
+    def _phrase_pf(self, terms, slop, pinfo, docs: np.ndarray):
+        """(pf f32[n], present bool[n]) of a phrase at candidate docs."""
+        if pinfo is not None:
+            pdocs, ppf = pinfo.docs, pinfo.pf
+        else:
+            flt = np.unique(np.asarray(docs, np.int64)).astype(np.int32)
+            pdocs, ppf = phrase_freqs(self.fp, list(terms), slop=slop,
+                                      docs_filter=flt)
+        pf = np.zeros(len(docs), np.float32)
+        if len(pdocs):
+            d = docs.astype(pdocs.dtype, copy=False) \
+                if docs.dtype != pdocs.dtype else docs
+            j = np.searchsorted(pdocs, d)
+            jc = np.minimum(j, len(pdocs) - 1)
+            hit = (j < len(pdocs)) & (pdocs[jc] == d)
+            pf[hit] = ppf[jc[hit]]
+        return pf, pf > 0
+
+    def _exact_bool(self, r: _BoolQuery, docs: np.ndarray):
+        """(scores f32[n], match bool[n]) at docs — expression-for-
+        expression the serving conjunctive reference
+        (search/serving._conjunctive_partition: f64 accumulation, clause
+        order conj -> should -> phrases, one f32 downcast at the end), so
+        Turbo's bool path is bit-identical to the REST host columnar
+        path. Clause lists are iterated in ORIGINAL spec order."""
+        fp = self.fp
+        n = len(docs)
+        match = np.ones(n, bool)
+        dl = fp.doc_len[docs]
+        norm = _K1 * (1.0 - _B + _B * dl / max(self._avgdl, 1e-9))
+        scores = np.zeros(n, np.float64)
+        for t, w, info in r.conj:
+            tf, present = tf_at(fp, t, docs)
+            match &= present
+            scores += w * info.idf * tf * (_K1 + 1.0) / (tf + norm)
+        for t, _ in r.filters:
+            _, present = tf_at(fp, t, docs)
+            match &= present
+        for t, w, info in r.should:
+            tf, present = tf_at(fp, t, docs)
+            contrib = (w * info.idf * tf * (_K1 + 1.0)
+                       / np.maximum(tf + norm, 1e-9))
+            scores += np.where(present, contrib, 0.0)
+        for terms, slop, boost, pinfo, idf_sum in r.phrases:
+            pf, present = self._phrase_pf(terms, slop, pinfo, docs)
+            match &= present
+            if boost == 0.0:
+                continue
+            scores += boost * idf_sum * pf * (_K1 + 1.0) / (pf + norm)
+        for t, _ in r.must_not:
+            _, present = tf_at(fp, t, docs)
+            match &= ~present
+        return scores.astype(np.float32), match
+
+    def _bool_host_exact(self, r: _BoolQuery, k: int):
+        """Exact host bool top-k: sorted-array intersection of the
+        required clauses, then the shared exact rescore. Complete without
+        any certificate — every match lies inside the rarest required
+        clause's postings. Serves host-routed queries AND the device
+        path's certificate-failure fallback."""
+        self.stats["bool_host"] += 1
+        fp = self.fp
+        empty = (np.empty(0, np.float32), np.empty(0, np.int32))
+        req: List[np.ndarray] = []
+        for _, _, info in r.conj:
+            lo, hi = (int(fp.post_start[info.ord]),
+                      int(fp.post_start[info.ord + 1]))
+            req.append(fp.post_doc[lo:hi])
+        for _, info in r.filters:
+            lo, hi = (int(fp.post_start[info.ord]),
+                      int(fp.post_start[info.ord + 1]))
+            req.append(fp.post_doc[lo:hi])
+        for _, _, _, pinfo, _ in r.phrases:
+            if pinfo is not None:
+                req.append(pinfo.docs)
+        cand: Optional[np.ndarray] = None
+        if req:
+            req.sort(key=len)
+            cand = req[0]
+            for s in req[1:]:
+                cand = cand[np.isin(cand, s, assume_unique=True)]
+                if not len(cand):
+                    return empty
+        for terms, slop, _, pinfo, _ in r.phrases:
+            if pinfo is not None:
+                continue
+            cand, _ = phrase_freqs(fp, list(terms), slop=slop,
+                                   docs_filter=cand)
+            if not len(cand):
+                return empty
+        if cand is None:
+            # no required clauses: candidates are the should-term union
+            arrs = []
+            for _, _, info in r.should:
+                lo, hi = (int(fp.post_start[info.ord]),
+                          int(fp.post_start[info.ord + 1]))
+                arrs.append(fp.post_doc[lo:hi])
+            if not arrs:
+                return empty
+            cand = np.unique(np.concatenate(arrs))
+        cand = cand[self._live_host[cand] > 0]
+        if not len(cand):
+            return empty
+        s, m = self._exact_bool(r, cand)
+        keep = m & (s > 0)
+        cand, s = cand[keep], s[keep]
+        sel = np.lexsort((cand, -s))[:k]
+        return s[sel], cand[sel].astype(np.int32)
+
+    def _finish_bool(self, r: _BoolQuery, cand_docs, bound: float, k: int):
+        """Device-path merge: exact rescore of collected docs + cold-
+        SHOULD enumeration + certificate, mirroring _finish_query."""
+        scoring, req, mn = self._bool_slots(r)
+        e_q = 1e-7
+        if scoring:
+            wmax = max(abs(w) for _, w, _ in scoring)
+            qs = max(wmax / 127.0, 1e-9)
+            qs2 = qs / 128.0
+            for _, w, _ in scoring:
+                wh = max(-127, min(127, round(w / qs)))
+                wl = max(-127, min(127, round((w - qs * wh) / qs2)))
+                w_approx = qs * wh + qs2 * wl
+                # full lo step: presence-only cells are forced to lo = 1
+                e_q += (abs(w - w_approx) * K1_PLUS1
+                        + abs(w_approx) * COLSCALE2)
+            e_q += 3e-7 * sum(abs(w) for _, w, _ in scoring) * K1_PLUS1
+        e_q = float(e_q)
+
+        cand_s = np.empty(0, np.float32)
+        if len(cand_docs):
+            cand_docs = np.asarray(cand_docs, np.int64)
+            s, m = self._exact_bool(r, cand_docs)
+            keep = m & (s > 0)
+            cand_docs, cand_s = cand_docs[keep], s[keep]
+        else:
+            cand_docs = np.empty(0, np.int64)
+
+        # cold SHOULD terms: a match the sweep scored without them (or,
+        # when every scoring clause is cold, never surfaced at all) gets
+        # its exact total here; bound-pruned like the disjunctive path
+        cold_should = [(t, b, i) for t, b, i in r.should
+                       if t not in self._slot_of]
+        cold_docs = np.empty(0, np.int64)
+        cold_s = np.empty(0, np.float32)
+        if cold_should:
+            self.stats["cold_queries"] += 1
+            docs_c, contrib = self._cold_contrib(cold_should)
+            lv = self._live_host[docs_c] > 0
+            docs_c, contrib = docs_c[lv], contrib[lv]
+            kth_0 = 0.0
+            if len(cand_s) >= k:
+                kth_0 = float(np.partition(cand_s, len(cand_s) - k)[
+                    len(cand_s) - k])
+            col_const = sum(abs(w) * sm for _, w, sm in scoring)
+            survivors = docs_c[contrib + col_const + 1e-5 >= kth_0]
+            if len(survivors):
+                s, m = self._exact_bool(r, survivors)
+                keep = m & (s > 0)
+                cold_docs, cold_s = survivors[keep], s[keep]
+
+        docs = np.concatenate([cand_docs, cold_docs])
+        totals = np.concatenate([cand_s, cold_s])
+        if len(docs):
+            docs, first = np.unique(docs, return_index=True)
+            totals = totals[first]
+        sel = np.lexsort((docs, -totals))[:k]
+        out_s, out_d = totals[sel], docs[sel].astype(np.int32)
+
+        # certificate: collected docs are exact; a doc hidden in an
+        # uncollected row passed the same (exact) coverage mask, so its
+        # true colized score is bounded by the row bound + e_q, and any
+        # cold-should addend it has was enumerated above
+        uncollected = float(bound)
+        limit = uncollected + e_q
+        kth = float(out_s[k - 1]) if len(out_s) >= k else 0.0
+        short = len(out_s) < k and uncollected > 0
+        if (short
+                or (len(out_s) >= k and kth < limit and uncollected > 0)
+                or self.force_cert_fail):
+            self.stats["fallbacks"] += 1
+            return self._bool_host_exact(r, k)
+        return out_s, out_d
+
+    def search_bool(self, queries: Sequence[dict], k: int = 10,
+                    check=None):
+        """(scores [Q, k] f32, ords [Q, k] i32) for bool query specs (see
+        _resolve_bool for the spec shape). Matches with non-positive
+        scores are dropped (the BlockMax search_bool contract). Device
+        and host routes return bit-identical results — both rescore
+        through _exact_bool."""
+        Q = len(queries)
+        out_s = np.zeros((Q, k), np.float32)
+        out_d = np.zeros((Q, k), np.int32)
+        resolved = [self._resolve_bool(spec) for spec in queries]
+
+        ens_terms: List[str] = []
+        ens_phr: List[Tuple[str, ...]] = []
+        pkeys = set()
+        for r in resolved:
+            if r is None or not r.dev_candidate:
+                continue
+            ens_terms += [t for t, _, _ in r.conj]
+            ens_terms += [t for t, _ in r.filters]
+            ens_terms += [t for t, _, i in r.should
+                          if i.df >= self.cold_df]
+            ens_terms += [t for t, i in r.must_not
+                          if i.df >= self.cold_df]
+            for terms, _, _, pinfo, _ in r.phrases:
+                if pinfo is not None:
+                    ens_phr.append(pinfo.terms)
+                    pkeys.add(pinfo.key)
+        if ens_terms:
+            self.ensure_columns(ens_terms, protect_extra=pkeys)
+        if ens_phr:
+            self.ensure_phrases(ens_phr,
+                                protect_extra=set(ens_terms) | pkeys)
+
+        device_idx: List[int] = []
+        host_idx: List[int] = []
+        for qi, r in enumerate(resolved):
+            if r is None:
+                continue
+            if r.dev_candidate and self._bool_resident(r):
+                device_idx.append(qi)
+            else:
+                host_idx.append(qi)
+        self.stats["bool_device"] += len(device_idx)
+
+        # device pipeline (same two-pass shape as search_many)
+        n_rows = max(_GLOBAL_ROWS, k + 5)
+        pending = []
+        off = 0
+        while off < len(device_idx):
+            rem = len(device_idx) - off
+            take = next((s for s in self.qc_sizes if s >= rem),
+                        self.qc_sizes[-1])
+            sel = device_idx[off: off + take]
+            if check is not None:
+                check()
+            rm, rr = self._sweep_bool([resolved[i] for i in sel],
+                                      take)
+            pending.append((sel, _pick_rows(rm, rr, n_rows=n_rows)))
+            off += len(sel)
+        self.stats["dispatches"] += len(pending)
+
+        lane = np.arange(128, dtype=np.int64)
+        for sel, packed_dev in pending:
+            if check is not None:
+                check()
+            packed = np.asarray(packed_dev)
+            rows_all = packed[:, :n_rows].astype(np.int64)
+            bounds = packed[:, n_rows]
+            for j, qi in enumerate(sel):
+                rw = rows_all[j]
+                rw = rw[rw >= 0]
+                docs = (rw[:, None] * 128 + lane[None, :]).ravel()
+                if len(docs):
+                    docs = docs[self._live_host[docs] > 0]
+                s, d = self._finish_bool(resolved[qi], docs,
+                                         float(bounds[j]), k)
+                out_s[qi, : len(s)] = s
+                out_d[qi, : len(d)] = d
+        for qi in host_idx:
+            if check is not None:
+                check()
+            s, d = self._bool_host_exact(resolved[qi], k)
+            out_s[qi, : len(s)] = s
+            out_d[qi, : len(d)] = d
+        return out_s, out_d
+
+    def search_phrase(self, phrases: Sequence[Sequence[str]], k: int = 10,
+                      slop: int = 0, check=None):
+        """(scores [Q, k], ords [Q, k]) for bare phrase queries — sugar
+        over search_bool; slop-0 phrases ride the adjacency columns."""
+        specs = [{"phrases": [(list(p), slop, 1.0)]} for p in phrases]
+        return self.search_bool(specs, k=k, check=check)

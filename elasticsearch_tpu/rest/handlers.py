@@ -540,12 +540,16 @@ class _Handlers:
         out = {}
         require = not req.param_bool("ignore_unavailable")
         for name in self._resolve(req.param("index"), require=require):
-            out[name] = {"mappings": self.node.indices.get(name).mapper.mapping()}
+            svc = self.node.indices.get(name)
+            svc.check_metadata_allowed()
+            out[name] = {"mappings": svc.mapper.mapping()}
         return _ok(out)
 
     def put_mapping(self, req: RestRequest) -> RestResponse:
         for name in self._resolve(req.param("index"), require=True):
-            self.node.indices.get(name).mapper.merge(req.body or {})
+            svc = self.node.indices.get(name)
+            svc.check_metadata_allowed()
+            svc.mapper.merge(req.body or {})
         return _ok({"acknowledged": True})
 
     def put_settings(self, req: RestRequest) -> RestResponse:
@@ -588,14 +592,21 @@ class _Handlers:
                          "index.max_terms_count",
                          "index.max_result_window",
                          "index.refresh_interval"):
-                pass          # accepted; blocks enforce on the data path
+                pass          # enforced by IndexService.check_*_allowed
             else:
                 raise IllegalArgumentError(
                     f"Can't update non dynamic setting [{key}]")
             flat[key] = raw
 
+        # The metadata block rejects settings updates UNLESS the request
+        # only toggles index.blocks.* itself — otherwise a metadata block
+        # could never be removed (ref: TransportUpdateSettingsAction
+        # .checkBlock skips the block for all-blocks requests).
+        only_blocks = all(k.startswith("index.blocks.") for k in flat)
         for name in self._resolve(req.param("index"), require=True):
             svc = self.node.indices.get(name)
+            if not only_blocks:
+                svc.check_metadata_allowed()
             new_meta = _dc.replace(
                 svc.meta, settings=svc.meta.settings.with_updates(flat))
             svc.meta = new_meta
@@ -626,6 +637,7 @@ class _Handlers:
     def get_settings(self, req: RestRequest) -> RestResponse:
         out = {}
         for name in self._resolve(req.param("index"), require=True):
+            self.node.indices.get(name).check_metadata_allowed()
             meta = self.node.cluster_state.indices[name]
             out[name] = {"settings": {"index": {
                 "number_of_shards": str(meta.number_of_shards),

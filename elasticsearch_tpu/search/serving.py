@@ -329,6 +329,46 @@ class TurboEngine:
             results.append((out_s, out_p, out_o))
         return results
 
+    def _merge3(self, per, Q: int, k: int):
+        """Merge per-partition (scores, docs) into the engine-wide
+        (scores, partition, ord) contract — same tie-break as
+        search_many: (score desc, partition asc, doc asc)."""
+        out_s = np.zeros((Q, k), np.float32)
+        out_p = np.zeros((Q, k), np.int32)
+        out_o = np.zeros((Q, k), np.int32)
+        if len(per) == 1:
+            s, d = per[0]
+            out_s, out_o = s.copy(), d.copy()
+            out_o[out_s <= 0] = 0
+            return out_s, out_p, out_o
+        for qi in range(Q):
+            cand = [(float(s), pi, int(d))
+                    for pi, (ss, dd) in enumerate(per)
+                    for s, d in zip(ss[qi], dd[qi]) if s > 0]
+            cand.sort(key=lambda x: (-x[0], x[1], x[2]))
+            for j, (s, pi, d) in enumerate(cand[:k]):
+                out_s[qi, j] = s
+                out_p[qi, j] = pi
+                out_o[qi, j] = d
+        return out_s, out_p, out_o
+
+    def search_bool(self, queries: Sequence[dict], k: int = 10,
+                    check=None):
+        """Batched bool top-k through the per-partition conjunctive
+        sweeps — the BlockMax search_bool contract:
+        (scores [Q,k], partition [Q,k], ord [Q,k])."""
+        per = [t.search_bool(queries, k=k, check=check)
+               for t in self.turbos]
+        return self._merge3(per, len(queries), k)
+
+    def search_phrase(self, phrases: Sequence[List[str]], k: int = 10,
+                      slop: int = 0, check=None):
+        """Batched match_phrase top-k; slop-0 rides the adjacency
+        columns, other slops the exact host positional path."""
+        per = [t.search_phrase(phrases, k=k, slop=slop, check=check)
+               for t in self.turbos]
+        return self._merge3(per, len(phrases), k)
+
     def hbm_bytes(self) -> int:
         total = 0
         for t in self.turbos:
@@ -541,25 +581,20 @@ def _post_docs(fp, term: str) -> np.ndarray:
 
 
 def _tf_at(fp, term: str, docs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """(tf f32[n], present bool[n]) of `term` for sorted candidate docs."""
-    o = fp.term_to_ord.get(term)
-    if o is None:
-        return np.zeros(len(docs), np.float32), np.zeros(len(docs), bool)
-    lo, hi = int(fp.post_start[o]), int(fp.post_start[o + 1])
-    seg = fp.post_doc[lo:hi]
-    j = np.searchsorted(seg, docs)
-    present = (j < hi - lo)
-    present[present] = seg[j[present]] == docs[present]
-    within = np.where(present, j, 0).astype(np.int64)
-    row = int(fp.block_start[o]) + within // 128
-    lane = within % 128
-    tf = fp.block_tfs[row, lane].astype(np.float32)
-    return np.where(present, tf, 0.0), present
+    """(tf f32[n], present bool[n]) of `term` for sorted candidate docs.
+    Shared with TurboBM25's bool rescore (index/segment.py tf_at) so both
+    paths stay bit-identical."""
+    from elasticsearch_tpu.index.segment import tf_at
+
+    return tf_at(fp, term, docs)
 
 
-def _conjunctive_partition(plan: FlatPlan, snap: ServingSnapshot,
-                           part: _Partition):
-    """(docs, scores) for one partition — all host columnar ops."""
+def _conjunctive_candidates(plan: FlatPlan, snap: ServingSnapshot,
+                            part: _Partition):
+    """(cand docs, aligned phrase (pf, boost, idf_sum) list) for one
+    partition after all required-clause narrowing (intersection, phrase
+    verify, must_not, live) — shared by the scoring host path and the
+    count-only totals pass used when TurboBM25 serves the hits."""
     seg = part.segment
     fp = seg.postings.get(plan.field) if plan.field else None
     req: List[np.ndarray] = []
@@ -623,6 +658,18 @@ def _conjunctive_partition(plan: FlatPlan, snap: ServingSnapshot,
         narrow(part.live[cand])
     if not len(cand):
         return None
+    return cand, phrase_pf
+
+
+def _conjunctive_partition(plan: FlatPlan, snap: ServingSnapshot,
+                           part: _Partition):
+    """(docs, scores) for one partition — all host columnar ops."""
+    r = _conjunctive_candidates(plan, snap, part)
+    if r is None:
+        return None
+    cand, phrase_pf = r
+    seg = part.segment
+    fp = seg.postings.get(plan.field) if plan.field else None
 
     _, avgdl, _ = snap.stats(plan.field) if plan.field else (0, 1.0, None)
     dl = fp.doc_len[cand] if fp is not None else np.zeros(len(cand), np.float32)
@@ -641,6 +688,37 @@ def _conjunctive_partition(plan: FlatPlan, snap: ServingSnapshot,
             continue
         scores += boost * idf_sum * pf * (K1 + 1.0) / (pf + norm)
     return cand, scores.astype(np.float32)
+
+
+def _turbo_bool_spec(plan: FlatPlan) -> Optional[dict]:
+    """Convert a conjunctive FlatPlan into a TurboBM25.search_bool spec,
+    or None when turbo's contract can't represent it: every clause must
+    be a single term on the scoring field, and every match must be
+    guaranteed a positive score (the engine drops score<=0 matches; the
+    host columnar path keeps them)."""
+    if plan.field is None or plan.disj:
+        return None
+    for f, terms in plan.filters:
+        if f != plan.field or len(terms) != 1:
+            return None          # cross-field / any-of filter groups
+    for f, _ in plan.must_not:
+        if f != plan.field:
+            return None
+    if (any(w < 0 for _, w in plan.conj)
+            or any(w < 0 for _, w in plan.should)
+            or any(b < 0 for _, _, b in plan.phrases)):
+        return None
+    if not (any(w > 0 for _, w in plan.conj)
+            or any(b > 0 for _, _, b in plan.phrases)):
+        return None              # no positively-scored required clause
+    return {
+        "must": list(plan.conj),
+        "should": list(plan.should),
+        "filter": [terms[0] for _, terms in plan.filters],
+        "must_not": [t for _, terms in plan.must_not for t in terms],
+        "phrases": [(list(terms), int(slop), float(boost))
+                    for terms, slop, boost in plan.phrases],
+    }
 
 
 class ServingContext:
@@ -740,17 +818,37 @@ class ServingContext:
         if len(self.svc.shards) != 1:
             return None             # per-shard adapter always has one
         plan = extract_plan(request, self.svc.mapper)
-        if plan is None or not plan.is_disjunctive:
+        if plan is None:
             return None
         snap = self.snapshot()
-        if snap.total_docs == 0 or not self._disj_servable(
-                plan, snap, request):
+        if snap.total_docs == 0:
             return None
         k = int(request.get("from", 0)) + int(request.get("size", 10))
-        eng = snap.engine(plan.field)
         check = task.check if task is not None else None
-        scores, parts, ords = eng.search_many([[plan.disj]], k=k,
-                                              check=check)[0]
+        if plan.is_disjunctive:
+            if not self._disj_servable(plan, snap, request):
+                return None
+            eng = snap.engine(plan.field)
+            scores, parts, ords = eng.search_many([[plan.disj]], k=k,
+                                                  check=check)[0]
+            total_rel = self._disj_total
+        elif plan.is_conjunctive and plan.field is not None:
+            # conjunctive / phrase plans serve through the same engine
+            # when it is Turbo (presence-mask sweep + adjacency columns);
+            # otherwise the dense executor remains the query phase
+            eng = snap.engine(plan.field)
+            if getattr(eng, "kind", "") != "turbo":
+                return None
+            spec = _turbo_bool_spec(plan)
+            if spec is None:
+                return None
+            scores, parts, ords = eng.search_bool([spec], k=k,
+                                                  check=check)
+
+            def total_rel(p, sn, req, n):
+                return self._conj_total(p, sn, req)
+        else:
+            return None
         hits = []
         max_score = None
         for j in range(k):
@@ -762,7 +860,7 @@ class ServingContext:
             hits.append(ShardHit(leaf_idx=part.leaf_idx, ord=o, score=s,
                                  global_ord=part.base + o))
             max_score = s if max_score is None else max(max_score, s)
-        total, relation = self._disj_total(plan, snap, request, len(hits))
+        total, relation = total_rel(plan, snap, request, len(hits))
         return QuerySearchResult(total=total, relation=relation, hits=hits,
                                  max_score=max_score)
 
@@ -826,10 +924,44 @@ class ServingContext:
             return track_n, "gte"
         return count, "eq"
 
-    # ---- conjunctive (host columnar) ----
+    # ---- conjunctive (turbo device path or host columnar) ----
+
+    def _conj_total(self, plan, snap, request) -> Tuple[int, str]:
+        """Exact conjunctive hit count (same narrowing as the host
+        scoring path, no scoring) with the track_total_hits cap — the
+        totals side when TurboBM25 serves the hits."""
+        total = 0
+        for part in snap.partitions:
+            r = _conjunctive_candidates(plan, snap, part)
+            if r is not None:
+                total += len(r[0])
+        track = request.get("track_total_hits", 10000)
+        if track is False:
+            return total, "gte"
+        track_n = 1 << 62 if track is True else int(track)
+        if total > track_n:
+            return track_n, "gte"
+        return total, "eq"
 
     def _conjunctive(self, plan, snap, request, start):
         k = int(request.get("from", 0)) + int(request.get("size", 10))
+        eng = snap.engine(plan.field) if plan.field else None
+        spec = _turbo_bool_spec(plan) \
+            if getattr(eng, "kind", "") == "turbo" else None
+        if spec is not None:
+            # the flagship engine serves the hits (conjunctive sweep over
+            # the int8 columns, bit-identical rescore); totals come from
+            # the same count the host path would have produced
+            scores, parts, ords = eng.search_bool([spec], k=k)
+            hits = []
+            for j in range(k):
+                s = float(scores[0, j])
+                if s <= 0 or not np.isfinite(s):
+                    break
+                hits.append((int(parts[0, j]), int(ords[0, j]), s))
+            total, relation = self._conj_total(plan, snap, request)
+            return self._respond(request, snap, hits, total, relation,
+                                 start)
         all_s, all_p, all_o = [], [], []
         total = 0
         for pi, part in enumerate(snap.partitions):

@@ -166,3 +166,46 @@ def test_incremental_reduce_breaker_trips_on_huge_partials():
                            "aggs": part})
 
 
+
+
+def test_consumer_release_frees_reserved_bytes_after_trip():
+    """The coordinator's error path must release pending-partial breaker
+    bytes (consumer.release in SearchActionService's except) — a tripped
+    search used to leave _reserved accounted forever."""
+    from elasticsearch_tpu.action.search_action import (
+        _QueryPhaseResultConsumer,
+    )
+    from elasticsearch_tpu.common.breaker import CircuitBreaker
+    from elasticsearch_tpu.common.errors import CircuitBreakingError
+
+    body = {"size": 1, "batched_reduce_size": 512}
+    # limit fits a few partials: the trip's own bytes roll back, but the
+    # EARLIER consumes' reservations stay accounted in _reserved
+    part = encode_value({"big": np.zeros(512, np.float64)})
+    breaker = CircuitBreaker("request", 3 * 8 * 512)
+    c = _QueryPhaseResultConsumer(body, sort=None, k=1, breaker=breaker)
+    with pytest.raises(CircuitBreakingError):
+        for si in range(10):
+            c.consume(si, {"total": 0, "relation": "eq", "hits": [],
+                           "aggs": part})
+    assert breaker.used_bytes > 0              # the leak being tested
+    c.release()
+    assert breaker.used_bytes == 0
+    c.release()                                # idempotent
+    assert breaker.used_bytes == 0
+
+
+def test_cluster_node_shares_one_indexing_pressure():
+    """Every write stage on a node accounts against ONE IndexingPressure
+    (ref: IndexingPressure.java is a node-level singleton) — the shard
+    service must reuse the node's instance, not grow its own budget."""
+    from elasticsearch_tpu.cluster_node import form_local_cluster
+
+    nodes, store, channels = form_local_cluster(["a", "b"])
+    try:
+        for node in nodes:
+            assert node.shard_service.indexing_pressure \
+                is node.indexing_pressure
+    finally:
+        for node in nodes:
+            node.close()

@@ -266,3 +266,45 @@ def test_real_http_roundtrip():
     finally:
         server.stop()
         node.close()
+
+
+def test_index_blocks_read_and_metadata_enforced(api):
+    """index.blocks.read gates data reads, index.blocks.metadata gates
+    mapping/settings access — and a metadata-blocked index must still
+    accept a blocks-only settings update so the block can be lifted
+    (ref: TransportUpdateSettingsAction.checkBlock)."""
+    call, _ = api
+    assert call("PUT", "/b", {"mappings": {
+        "properties": {"t": {"type": "text"}}}}).status == 200
+    assert call("PUT", "/b/_doc/1", {"t": "hello world"}).status == 201
+    call("POST", "/b/_refresh")
+
+    assert call("PUT", "/b/_settings",
+                {"index.blocks.read": True}).status == 200
+    for method, path, body in [
+            ("GET", "/b/_doc/1", None),
+            ("POST", "/b/_search", {"query": {"match_all": {}}}),
+            ("POST", "/b/_count", None),
+            ("POST", "/b/_mget", {"ids": ["1"]})]:
+        r = call(method, path, body)
+        assert r.status == 403, (method, path, r.body)
+        assert "cluster_block_exception" in json.dumps(r.body)
+    # a read block does NOT gate writes
+    assert call("PUT", "/b/_doc/2", {"t": "two"}).status == 201
+    assert call("PUT", "/b/_settings",
+                {"index.blocks.read": False}).status == 200
+    assert call("GET", "/b/_doc/1").status == 200
+
+    assert call("PUT", "/b/_settings",
+                {"index.blocks.metadata": True}).status == 200
+    assert call("GET", "/b/_mapping").status == 403
+    assert call("GET", "/b/_settings").status == 403
+    assert call("PUT", "/b/_mapping",
+                {"properties": {"x": {"type": "keyword"}}}).status == 403
+    # non-block settings updates are refused while metadata-blocked...
+    assert call("PUT", "/b/_settings",
+                {"index.refresh_interval": "1s"}).status == 403
+    # ...but the block itself can always be lifted
+    assert call("PUT", "/b/_settings",
+                {"index.blocks.metadata": False}).status == 200
+    assert call("GET", "/b/_mapping").status == 200
