@@ -53,6 +53,7 @@ import json
 import os
 import signal
 import sys
+import threading
 import time
 
 import numpy as np
@@ -487,6 +488,77 @@ def main():
     RESULT["vs_baseline"] = round(match_qps / cpu_match_qps, 2)
     log(f"config1: {match_qps:.1f} qps, {RESULT['vs_baseline']}x cpu, "
         f"agreement {match_agree}, p95(1) {c1['latency_ms_batch1_p95']}ms")
+
+    # ===== config1_concurrent: dispatch coalescer under open client load ==
+    # 32 client threads each firing batch-1 match queries at the SAME
+    # engine; the coalescer (threadpool/coalescer.py) merges the
+    # concurrent singles into padded device batches. Run twice — window
+    # from env (default 2000us) vs ES_TPU_COALESCE_US=0 semantics — and
+    # compare tail latency + top-10 agreement between the two runs.
+    if left() > 240:
+        from elasticsearch_tpu.threadpool.coalescer import DispatchCoalescer
+
+        n_threads = 32
+        # size the run from the MEASURED batch-1 latency so the window=0
+        # leg (worst case: fully serialized singles) cannot starve the
+        # later configs — cap its estimated cost at min(60s, 15% budget)
+        p50_s = pct(lat1, 50) / 1e3
+        conc_budget_s = min(60.0, left() * 0.15)
+        per_thread = max(1, min(
+            8, int(conc_budget_s / max(n_threads * p50_s, 1e-6))))
+        log(f"config1_concurrent ({n_threads} threads x {per_thread})...")
+        thread_qs = [draw_batch(per_thread) for _ in range(n_threads)]
+
+        def run_concurrent(window_us):
+            co = DispatchCoalescer(window_us=window_us)
+            lats = [[] for _ in range(n_threads)]
+            ordrows = [[] for _ in range(n_threads)]
+            barrier = threading.Barrier(n_threads)
+
+            def client(i):
+                barrier.wait()
+                for q in thread_qs[i]:
+                    t1 = time.time()
+                    _, _, o = co.dispatch(eng, [q], K)
+                    lats[i].append(time.time() - t1)
+                    ordrows[i].append(np.asarray(o[0]))
+
+            ts = [threading.Thread(target=client, args=(i,), daemon=True)
+                  for i in range(n_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            flat = [x for xs in lats for x in xs]
+            rows = [r for rs in ordrows for r in rs]
+            return flat, rows, co.stats()
+
+        solo_lat, solo_rows, _ = run_concurrent(0)
+        co_lat, co_rows, co_st = run_concurrent(None)
+        agree_conc = float(np.mean([np.array_equal(a, b) for a, b
+                                    in zip(co_rows, solo_rows)]))
+        detail["config1_concurrent"] = {
+            "threads": n_threads,
+            "queries_per_thread": per_thread,
+            "coalesced": {
+                "p50_ms": round(pct(co_lat, 50), 1),
+                "p95_ms": round(pct(co_lat, 95), 1),
+                "mean_batch": co_st["mean_batch"],
+                "largest_batch": co_st["largest_batch"],
+                "coalesced_dispatches": co_st["coalesced_dispatches"],
+                "direct_dispatches": co_st["direct_dispatches"],
+                "window_us": co_st["window_us"],
+            },
+            "window0": {
+                "p50_ms": round(pct(solo_lat, 50), 1),
+                "p95_ms": round(pct(solo_lat, 95), 1),
+            },
+            "top10_agreement": round(agree_conc, 4),
+        }
+        log(f"config1_concurrent: p95 {pct(co_lat, 95):.0f}ms coalesced "
+            f"(mean batch {co_st['mean_batch']}) vs "
+            f"{pct(solo_lat, 95):.0f}ms window=0, "
+            f"agreement {agree_conc}")
 
     # ================= config 4: knn (cheap; before the host-heavy ones) ==
     if left() > 180:

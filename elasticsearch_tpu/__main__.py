@@ -35,7 +35,8 @@ def main(argv=None) -> int:
     loaded = load_plugins(node, rc)
     if loaded:
         print(f"[{args.name}] plugins loaded: {', '.join(loaded)}", flush=True)
-    server = HttpServer(rc, host=args.host, port=args.port)
+    server = HttpServer(rc, host=args.host, port=args.port,
+                        thread_pool=node.thread_pool)
     server.start()
     print(f"[{args.name}] started, http on {args.host}:{server.port}", flush=True)
 

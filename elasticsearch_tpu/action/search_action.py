@@ -204,17 +204,30 @@ class SearchActionService:
     """Shard-level query/fetch handlers + the coordinator entrypoint."""
 
     def __init__(self, transport: TransportService, channels: NodeChannels,
-                 shard_service: DistributedShardService, breakers=None):
+                 shard_service: DistributedShardService, breakers=None,
+                 thread_pool=None):
         from elasticsearch_tpu.common.breaker import (
             HierarchyCircuitBreakerService,
         )
+        from elasticsearch_tpu.threadpool import ThreadPool
 
         self.channels = channels
         self.shards = shard_service
         self.breakers = breakers or HierarchyCircuitBreakerService()
         self.contexts = ReaderContextRegistry()
-        transport.register_request_handler(ACTION_QUERY, self._on_shard_query)
-        transport.register_request_handler(ACTION_FETCH, self._on_shard_fetch)
+        # shard query/fetch phases run on the node's SEARCH pool —
+        # bounded and isolated from the write stage (a worker of the
+        # same pool re-enters inline, so a coordinator running on a
+        # search worker serves its local shards without self-deadlock)
+        self.thread_pool = thread_pool or ThreadPool()
+        transport.register_request_handler(
+            ACTION_QUERY,
+            lambda req: self.thread_pool.execute(
+                "search", self._on_shard_query, req))
+        transport.register_request_handler(
+            ACTION_FETCH,
+            lambda req: self.thread_pool.execute(
+                "search", self._on_shard_fetch, req))
         transport.register_request_handler(ACTION_FREE, self._on_free_context)
         transport.register_request_handler(ACTION_CAN_MATCH,
                                            self._on_can_match)

@@ -71,13 +71,22 @@ class ClusterNode:
         # IndexingPressure instances would admit twice the bytes
         # (ref: IndexingPressure.java is a node-level singleton)
         self.indexing_pressure = IndexingPressure()
+        from elasticsearch_tpu.threadpool import ThreadPool
+
+        # same singleton rule for the stage executors: the shard service's
+        # write handlers and the search action's query/fetch handlers run
+        # on ONE node-level ThreadPool, so saturating writes can never
+        # occupy search workers (and vice versa)
+        self.thread_pool = ThreadPool()
         self.shard_service = DistributedShardService(
             node_name, self.transport, channels, self.master_client,
-            data_path, indexing_pressure=self.indexing_pressure)
+            data_path, indexing_pressure=self.indexing_pressure,
+            thread_pool=self.thread_pool)
         self.applier = IndicesClusterStateService(
             node_name, self.shard_service, self.master_client)
         self.search_action = SearchActionService(
-            self.transport, channels, self.shard_service)
+            self.transport, channels, self.shard_service,
+            thread_pool=self.thread_pool)
         t = self.transport
         t.register_request_handler("indices:admin/create",
                                    self._on_create_index)
@@ -467,6 +476,7 @@ class ClusterNode:
         for key in list(self.shard_service.shards):
             self.shard_service.remove_shard(*key)
         self.transport.close()
+        self.thread_pool.shutdown()
 
 
 def _register_refresh_handler(node: ClusterNode) -> None:

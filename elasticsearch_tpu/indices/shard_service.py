@@ -98,7 +98,7 @@ class DistributedShardService:
                  channels: NodeChannels,
                  master_client: Callable[[str, dict], dict],
                  data_path: Optional[str] = None,
-                 indexing_pressure=None):
+                 indexing_pressure=None, thread_pool=None):
         self.node_name = node_name
         self.transport = transport
         self.channels = channels
@@ -108,15 +108,24 @@ class DistributedShardService:
         self.state: ClusterState = ClusterState()
         self._registry_lock = threading.Lock()
         from elasticsearch_tpu.common.indexing_pressure import IndexingPressure
+        from elasticsearch_tpu.threadpool import ThreadPool
 
         # per-node write backpressure (ref: index/IndexingPressure.java) —
         # injectable so all of a node's stages share ONE budget
         self.indexing_pressure = indexing_pressure or IndexingPressure()
+        # injectable for the same reason: the bulk stages execute on the
+        # node's WRITE pool so a bulk storm is bounded by write workers
+        # and cannot occupy the search stage (ref: ThreadPool.Names.WRITE)
+        self.thread_pool = thread_pool or ThreadPool()
         t = transport
-        t.register_request_handler("indices:data/write/bulk[s]",
-                                   self._on_primary_bulk)
-        t.register_request_handler("indices:data/write/bulk[s][r]",
-                                   self._on_replica_bulk)
+        t.register_request_handler(
+            "indices:data/write/bulk[s]",
+            lambda req: self.thread_pool.execute(
+                "write", self._on_primary_bulk, req))
+        t.register_request_handler(
+            "indices:data/write/bulk[s][r]",
+            lambda req: self.thread_pool.execute(
+                "write", self._on_replica_bulk, req))
         t.register_request_handler("internal:index/shard/recovery/prepare",
                                    self._on_recovery_prepare)
         t.register_request_handler("internal:index/shard/recovery/segments",

@@ -41,6 +41,12 @@ class Node:
 
         self.indexing_pressure = IndexingPressure(int(self.settings.raw(
             "indexing_pressure.memory.limit", DEFAULT_LIMIT_BYTES)))
+        from elasticsearch_tpu.threadpool import ThreadPool
+
+        # ONE named-executor set per node (ref: ThreadPool.java is a
+        # node-level singleton) — the HTTP frontend and any attached
+        # services draw their stage workers from the same bounded pools
+        self.thread_pool = ThreadPool()
         from elasticsearch_tpu.security import SecurityService
 
         self.security = SecurityService(self.settings)
@@ -140,3 +146,4 @@ class Node:
     def close(self) -> None:
         self.indices.close()
         self.transport.close()
+        self.thread_pool.shutdown()

@@ -212,6 +212,8 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/_cat/aliases", h.cat_aliases)
     r("GET", "/_cat/allocation", h.cat_allocation)
     r("GET", "/_cat/templates", h.cat_templates)
+    r("GET", "/_cat/thread_pool", h.cat_thread_pool)
+    r("GET", "/_cat/thread_pool/{name}", h.cat_thread_pool)
 
 
 def _render_search_template(source, params: dict):
@@ -1891,6 +1893,8 @@ class _Handlers:
                     self.node.indices.get(n).doc_count() for n in self.node.indices.names())}},
                 "breakers": self.node.breakers.stats(),
                 "indexing_pressure": self.node.indexing_pressure.stats(),
+                "thread_pool": self.node.thread_pool.stats(),
+                "tpu_coalescer": _default_coalescer_stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
         })
@@ -2149,6 +2153,22 @@ class _Handlers:
         rows = [f"127.0.0.1 0 0 - cdfhilmrstw * {self.node.node_name}"]
         return RestResponse(body="\n".join(rows) + "\n", content_type="text/plain")
 
+    def cat_thread_pool(self, req: RestRequest) -> RestResponse:
+        """GET /_cat/thread_pool[/{name}] — the reference's default
+        columns: node_name name active queue rejected."""
+        import fnmatch as _fn
+
+        want = req.param("name")
+        pats = [p.strip() for p in want.split(",")] if want else None
+        rows = []
+        for name, st in sorted(self.node.thread_pool.stats().items()):
+            if pats and not any(_fn.fnmatchcase(name, p) for p in pats):
+                continue
+            rows.append(f"{self.node.node_name} {name} {st['active']} "
+                        f"{st['queue']} {st['rejected']}")
+        return RestResponse(body="\n".join(rows) + ("\n" if rows else ""),
+                            content_type="text/plain")
+
     # ---------- helpers ----------
 
     def _resolve(self, expression: str | None, require: bool = False) -> List[str]:
@@ -2157,6 +2177,12 @@ class _Handlers:
         if require and not names and expression not in ("_all", "*"):
             raise IndexNotFoundError(expression)
         return names
+
+
+def _default_coalescer_stats() -> dict:
+    from elasticsearch_tpu.threadpool.coalescer import default_coalescer
+
+    return default_coalescer().stats()
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

@@ -5,6 +5,14 @@ The analog of the reference's Netty4HttpServerTransport
 the HTTP layer is deliberately thin: parse method/path/query/body, dispatch,
 encode. Heavy lifting (search execution) releases the GIL inside XLA, so a
 threaded server keeps the device busy under concurrent clients.
+
+When a `ThreadPool` is attached, requests do NOT execute on the accept
+threads: each request is classified to a named stage pool (search / write /
+get / management / snapshot) and submitted there, so concurrency per stage
+is bounded and a saturated pool sheds load with 429
+`es_rejected_execution_exception` instead of queueing unboundedly
+(ref: the reference's per-action executor dispatch out of the Netty event
+loop).
 """
 
 from __future__ import annotations
@@ -13,12 +21,16 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlsplit
 
-from elasticsearch_tpu.rest.controller import RestController
+from elasticsearch_tpu.rest.controller import (
+    RestController, RestResponse, _error_body,
+)
 
 
 class HttpServer:
-    def __init__(self, controller: RestController, host: str = "127.0.0.1", port: int = 9200):
+    def __init__(self, controller: RestController, host: str = "127.0.0.1",
+                 port: int = 9200, thread_pool=None):
         self.controller = controller
+        self.thread_pool = thread_pool
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -32,9 +44,24 @@ class HttpServer:
                 params = dict(parse_qsl(parts.query, keep_blank_values=True))
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else None
-                resp = outer.controller.dispatch(self.command, parts.path,
-                                                 params, body,
-                                                 headers=dict(self.headers))
+                if outer.thread_pool is None:
+                    resp = outer.controller.dispatch(
+                        self.command, parts.path, params, body,
+                        headers=dict(self.headers))
+                else:
+                    from elasticsearch_tpu.threadpool import (
+                        EsRejectedExecutionError, pool_for_request,
+                    )
+
+                    pool = pool_for_request(self.command, parts.path)
+                    try:
+                        resp = outer.thread_pool.execute(
+                            pool, outer.controller.dispatch,
+                            self.command, parts.path, params, body,
+                            headers=dict(self.headers))
+                    except EsRejectedExecutionError as e:
+                        resp = RestResponse(status=e.status,
+                                            body=_error_body(e))
                 data = resp.encode()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)

@@ -3,6 +3,13 @@
 The analog of the reference SearchService.executeQueryPhase/executeFetchPhase
 pair (ref: search/SearchService.java:370,574) for a single shard; the
 distributed scatter-gather lives in parallel/ and transport/.
+
+Threading contract: this runs on whatever thread calls it — under REST
+traffic that is a worker of the node's bounded SEARCH pool
+(threadpool/pool.py; rest/http_server.py classifies requests to stages),
+never an unbounded accept thread. The serving fast path that fronts this
+executor (search/serving.py) additionally coalesces concurrent
+single-query dispatches into one device batch (threadpool/coalescer.py).
 """
 
 from __future__ import annotations

@@ -829,8 +829,14 @@ class ServingContext:
             if not self._disj_servable(plan, snap, request):
                 return None
             eng = snap.engine(plan.field)
-            scores, parts, ords = eng.search_many([[plan.disj]], k=k,
-                                                  check=check)[0]
+            # single-query dispatches ride the node's coalescer: concurrent
+            # shard queries on the same engine share ONE device dispatch
+            from elasticsearch_tpu.threadpool.coalescer import (
+                default_coalescer,
+            )
+
+            scores, parts, ords = default_coalescer().dispatch(
+                eng, [plan.disj], k, check=check)
             total_rel = self._disj_total
         elif plan.is_conjunctive and plan.field is not None:
             # conjunctive / phrase plans serve through the same engine
@@ -878,7 +884,12 @@ class ServingContext:
                 for r in requests)
         queries = [p.disj for p in plans]
         check = task.check if task is not None else None
-        scores, parts, ords = bm.search_many([queries], k=k, check=check)[0]
+        # small batches coalesce with concurrent dispatches on the same
+        # engine (threadpool/coalescer); large msearch batches go direct
+        from elasticsearch_tpu.threadpool.coalescer import default_coalescer
+
+        scores, parts, ords = default_coalescer().dispatch(
+            bm, queries, k, check=check)
         results = []
         for qi, (plan, request) in enumerate(zip(plans, requests)):
             hits = []

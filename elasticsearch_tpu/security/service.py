@@ -293,6 +293,11 @@ class SecurityService:
             self.authorize_cluster(authn, priv)
         elif kind == "index":
             self.authorize_index(authn, priv, indices)
+        elif kind == "multi":
+            # compound actions (_reindex): every (privilege, indices)
+            # check must pass
+            for p, idxs in priv:
+                self.authorize_index(authn, p, idxs)
         # kind == "open": _authenticate etc — authn only
 
 
@@ -302,10 +307,17 @@ _READ_ENDPOINTS = {"_search", "_msearch", "_count", "_mget", "_doc",
                    "_knn_search", "_rank_eval"}
 _WRITE_ENDPOINTS = {"_bulk", "_update", "_update_by_query",
                     "_delete_by_query", "_create"}
+# _reindex and _aliases are NOT here: both name data indices in their
+# bodies and classify as index actions below (a cluster-manage role must
+# not read arbitrary indices through reindex, nor repoint aliases on
+# indices it cannot manage). _scripts stays cluster-scoped on purpose —
+# stored scripts are cluster metadata (ref: cluster:admin/script/put);
+# data access only happens when a script runs inside a search, which is
+# authorized as that search.
 _CLUSTER_PREFIXES = {"_cluster", "_nodes", "_cat", "_tasks", "_snapshot",
                      "_scripts", "_ingest", "_template", "_index_template",
-                     "_component_template", "_aliases", "_alias", "_stats",
-                     "_async_search", "_reindex", "_render", "_scroll",
+                     "_component_template", "_alias", "_stats",
+                     "_async_search", "_render", "_scroll",
                      "_search_scroll", "_mapping", "_resolve"}
 
 
@@ -366,6 +378,37 @@ def _classify(req, parts: List[str]):
         targets = {str(d["_index"]) for d in (body.get("docs") or [])
                    if isinstance(d, dict) and d.get("_index")}
         return "index", "read", sorted(targets) or ["*"]
+    if head == "_reindex":
+        # an INDEX action on both ends — read the source, write the dest
+        # (ref: TransportReindexAction resolves per-index privileges); a
+        # body that names no index demands the privilege on "*" so a
+        # scoped role cannot widen through a malformed request
+        body = req.body if isinstance(req.body, dict) else {}
+        src = (body.get("source") or {}).get("index") \
+            if isinstance(body.get("source"), dict) else None
+        dst = (body.get("dest") or {}).get("index") \
+            if isinstance(body.get("dest"), dict) else None
+        src_list = sorted({str(s) for s in
+                           (src if isinstance(src, list) else [src]) if s}) \
+            or ["*"]
+        dst_list = [str(dst)] if dst else ["*"]
+        return "multi", [("read", src_list), ("write", dst_list)], None
+    if head == "_aliases":
+        # alias actions name their indices in the body: index `manage` on
+        # each target (ref: TransportIndicesAliasesAction)
+        body = req.body if isinstance(req.body, dict) else {}
+        targets = set()
+        for action in (body.get("actions") or []):
+            if not isinstance(action, dict):
+                continue
+            for spec in action.values():
+                if not isinstance(spec, dict):
+                    continue
+                v = spec.get("index") or spec.get("indices")
+                if v:
+                    targets.update(str(i) for i in
+                                   (v if isinstance(v, list) else [v]))
+        return "index", "manage", sorted(targets) or ["*"]
     if head.startswith("_") and head != "_all":
         if head in _CLUSTER_PREFIXES or head not in _READ_ENDPOINTS:
             return ("cluster",

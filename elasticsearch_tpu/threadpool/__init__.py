@@ -1,0 +1,9 @@
+from elasticsearch_tpu.threadpool.coalescer import (
+    DispatchCoalescer, default_coalescer,
+)
+from elasticsearch_tpu.threadpool.pool import (
+    EsRejectedExecutionError, FixedExecutor, ThreadPool, pool_for_request,
+)
+
+__all__ = ["DispatchCoalescer", "EsRejectedExecutionError", "FixedExecutor",
+           "ThreadPool", "default_coalescer", "pool_for_request"]

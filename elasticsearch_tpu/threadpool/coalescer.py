@@ -1,0 +1,173 @@
+"""TPU dispatch coalescer: micro-batching for concurrent small searches.
+
+BENCH_r05 measured the gap this closes: the Turbo engine sustains ~292
+qps at batch 256 but a single query pays 148-161ms p50/p95, because
+concurrent batch-1 searches each launch their OWN device dispatch. This
+is the continuous-batching regime from inference serving (and the eager
+batched-scoring regime BM25S, arxiv 2407.03618, shows for sparse BM25):
+hold concurrent single/small queries targeting the same engine for a
+short flush window, execute them as ONE padded `search_many` dispatch,
+and de-multiplex the rows back to their waiters.
+
+Bit-identity with solo execution is a hard requirement (the serving
+differential tests enforce it), so merging is conservative:
+
+- batches are keyed by `(engine identity, k)` — queries never share a
+  dispatch across engines (a snapshot refresh mid-window swaps the
+  engine object, so late arrivals key onto the NEW engine and in-flight
+  waiters finish on the snapshot they captured) and never across
+  different top-k depths;
+- both engines score and select top-k per query-row independently
+  (TurboBM25's host rescore is exact per query; BlockMax's pass-B pads
+  with row copies), so a merged row equals its solo row bitwise.
+
+The flush window comes from `ES_TPU_COALESCE_US` (microseconds, default
+2000; 0 disables coalescing entirely — every call dispatches solo).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_WINDOW_US = 2000.0
+# a query batch larger than this is already a good device shape — merging
+# it would only add latency to its peers
+SMALL_BATCH_MAX = 8
+# flush early once a held batch reaches this many queries
+MAX_BATCH = 64
+
+
+def _env_window_us() -> float:
+    v = os.environ.get("ES_TPU_COALESCE_US")
+    if v is None or v == "":
+        return DEFAULT_WINDOW_US
+    try:
+        return float(v)
+    except ValueError:
+        return DEFAULT_WINDOW_US
+
+
+class _PendingBatch:
+    __slots__ = ("engine", "k", "queries", "closed", "fill", "done",
+                 "results", "error")
+
+    def __init__(self, engine, k: int):
+        self.engine = engine
+        self.k = k
+        self.queries: List = []
+        self.closed = False
+        self.fill = threading.Event()    # wakes the leader early when full
+        self.done = threading.Event()    # results ready for the waiters
+        self.results = None
+        self.error: Optional[BaseException] = None
+
+
+class DispatchCoalescer:
+    """Merges concurrent `search_many` calls on the same engine+k into
+    one device dispatch. The FIRST arrival for a key becomes the batch
+    leader: it waits out the flush window (or until the batch fills),
+    closes the batch, runs the single merged dispatch, and publishes the
+    rows; followers only wait on the result event."""
+
+    def __init__(self, window_us: Optional[float] = None,
+                 max_batch: int = MAX_BATCH,
+                 small_batch_max: int = SMALL_BATCH_MAX):
+        self._window_us = window_us     # None -> read env per dispatch
+        self.max_batch = max_batch
+        self.small_batch_max = small_batch_max
+        self._lock = threading.Lock()
+        self._pending: Dict[Tuple[int, int], _PendingBatch] = {}
+        # stats
+        self._direct_dispatches = 0
+        self._coalesced_dispatches = 0
+        self._coalesced_queries = 0
+        self._largest_batch = 0
+
+    def window_us(self) -> float:
+        return self._window_us if self._window_us is not None \
+            else _env_window_us()
+
+    def dispatch(self, engine, queries: List, k: int, check=None):
+        """One batch of queries -> (scores [Q,k], partition [Q,k],
+        ord [Q,k]) — the engine `search_many` single-batch contract.
+        Small batches coalesce with concurrent peers; large ones (or a
+        zero window) dispatch directly."""
+        window_s = self.window_us() / 1e6
+        if check is not None:
+            # cooperative cancellation happens at the caller's boundary:
+            # a merged dispatch must never fail EVERY waiter because one
+            # task was cancelled
+            check()
+        if window_s <= 0 or len(queries) > self.small_batch_max:
+            with self._lock:
+                self._direct_dispatches += 1
+            return engine.search_many([list(queries)], k=k, check=check)[0]
+
+        key = (id(engine), int(k))
+        with self._lock:
+            batch = self._pending.get(key)
+            leader = batch is None
+            if leader:
+                batch = _PendingBatch(engine, int(k))
+                self._pending[key] = batch
+            base = len(batch.queries)
+            batch.queries.extend(queries)
+            if len(batch.queries) >= self.max_batch:
+                batch.closed = True
+                if self._pending.get(key) is batch:
+                    del self._pending[key]
+                batch.fill.set()
+
+        if leader:
+            batch.fill.wait(window_s)
+            with self._lock:
+                # close the window: late arrivals start a fresh batch
+                batch.closed = True
+                if self._pending.get(key) is batch:
+                    del self._pending[key]
+                n = len(batch.queries)
+                self._coalesced_dispatches += 1
+                self._coalesced_queries += n
+                if n > self._largest_batch:
+                    self._largest_batch = n
+            try:
+                batch.results = engine.search_many([batch.queries],
+                                                   k=batch.k)[0]
+            except BaseException as e:  # noqa: BLE001 — ferried to waiters
+                batch.error = e
+            finally:
+                batch.done.set()
+        else:
+            batch.done.wait()
+        if check is not None:
+            check()
+        if batch.error is not None:
+            raise batch.error
+        scores, parts, ords = batch.results
+        sl = slice(base, base + len(queries))
+        return scores[sl], parts[sl], ords[sl]
+
+    def stats(self) -> dict:
+        with self._lock:
+            merged = self._coalesced_queries
+            dispatches = self._coalesced_dispatches
+            return {
+                "window_us": self.window_us(),
+                "direct_dispatches": self._direct_dispatches,
+                "coalesced_dispatches": dispatches,
+                "coalesced_queries": merged,
+                "largest_batch": self._largest_batch,
+                "mean_batch": round(merged / dispatches, 3) if dispatches
+                else 0.0,
+            }
+
+
+# the process-default coalescer: ServingContext instances all dispatch
+# through it so concurrent searches coalesce across REST entry points
+_default = DispatchCoalescer()
+
+
+def default_coalescer() -> DispatchCoalescer:
+    return _default

@@ -65,6 +65,7 @@ API_TABLE: Dict[str, Tuple[str, str]] = {
     "cat.indices": ("GET", "/_cat/indices"),
     "cat.count": ("GET", "/_cat/count"),
     "cat.health": ("GET", "/_cat/health"),
+    "cat.thread_pool": ("GET", "/_cat/thread_pool"),
     "cat.shards": ("GET", "/_cat/shards"),
     "tasks.list": ("GET", "/_tasks"),
     "ingest.put_pipeline": ("PUT", "/_ingest/pipeline/{id}"),

@@ -170,6 +170,114 @@ def test_anonymous_roles_grant_configured_access():
         node.close()
 
 
+def test_reindex_requires_source_read_and_dest_write(api):
+    """_reindex is an INDEX action (read source + write dest), not a
+    cluster action: cluster-manage alone must not copy data between
+    indices the user cannot touch (ADVICE r5)."""
+    call, _ = api
+    call("PUT", "/_security/role/src_reader", {
+        "indices": [{"names": ["src-*"], "privileges": ["read"]}]},
+        headers=ELASTIC)
+    call("PUT", "/_security/role/dst_writer", {
+        "indices": [{"names": ["dst-*"], "privileges": ["write"]}]},
+        headers=ELASTIC)
+    call("PUT", "/_security/role/cluster_admin", {"cluster": ["manage"]},
+         headers=ELASTIC)
+    call("PUT", "/_security/user/mover", {
+        "password": "mpass", "roles": ["src_reader", "dst_writer"]},
+        headers=ELASTIC)
+    call("PUT", "/_security/user/reader_only", {
+        "password": "rpass", "roles": ["src_reader"]}, headers=ELASTIC)
+    call("PUT", "/_security/user/ops", {
+        "password": "opass", "roles": ["cluster_admin"]}, headers=ELASTIC)
+    call("PUT", "/src-1", {}, headers=ELASTIC)
+    call("PUT", "/dst-1", {}, headers=ELASTIC)
+    call("PUT", "/secret-src", {}, headers=ELASTIC)
+    call("PUT", "/src-1/_doc/1", {"f": "v"}, headers=ELASTIC)
+    call("POST", "/src-1/_refresh", headers=ELASTIC)
+
+    body = {"source": {"index": "src-1"}, "dest": {"index": "dst-1"}}
+    # read(source) + write(dest) suffices — no cluster privilege needed
+    r = call("POST", "/_reindex", body, headers=_basic("mover", "mpass"))
+    assert r.status == 200, r.body
+    # missing write on dest
+    assert call("POST", "/_reindex", body,
+                headers=_basic("reader_only", "rpass")).status == 403
+    # cluster manage grants NO data access through reindex
+    assert call("POST", "/_reindex", body,
+                headers=_basic("ops", "opass")).status == 403
+    # out-of-scope source: read privilege checked on the body's index
+    assert call("POST", "/_reindex",
+                {"source": {"index": "secret-src"},
+                 "dest": {"index": "dst-1"}},
+                headers=_basic("mover", "mpass")).status == 403
+    # a body naming no indices demands the privileges on "*"
+    assert call("POST", "/_reindex", {},
+                headers=_basic("mover", "mpass")).status == 403
+    assert call("POST", "/_reindex", body, headers=ELASTIC).status == 200
+
+
+def test_aliases_actions_require_index_manage(api):
+    """POST /_aliases names its target indices in the body: index
+    `manage` on each, not a cluster privilege (same audit as _reindex)."""
+    call, _ = api
+    call("PUT", "/_security/role/logs_mgr", {
+        "indices": [{"names": ["logs-*"], "privileges": ["manage"]}]},
+        headers=ELASTIC)
+    call("PUT", "/_security/role/cluster_admin2", {"cluster": ["manage"]},
+         headers=ELASTIC)
+    call("PUT", "/_security/user/mgr", {
+        "password": "gpass", "roles": ["logs_mgr"]}, headers=ELASTIC)
+    call("PUT", "/_security/user/ops2", {
+        "password": "o2pass", "roles": ["cluster_admin2"]}, headers=ELASTIC)
+    call("PUT", "/logs-al", {}, headers=ELASTIC)
+    call("PUT", "/secret-al", {}, headers=ELASTIC)
+
+    add_logs = {"actions": [{"add": {"index": "logs-al", "alias": "la"}}]}
+    add_secret = {"actions": [{"add": {"index": "secret-al", "alias": "sa"}}]}
+    assert call("POST", "/_aliases", add_logs,
+                headers=_basic("mgr", "gpass")).status == 200
+    # manage on logs-* does not reach secret-al
+    assert call("POST", "/_aliases", add_secret,
+                headers=_basic("mgr", "gpass")).status == 403
+    # cluster manage alone cannot repoint aliases over data indices
+    assert call("POST", "/_aliases", add_logs,
+                headers=_basic("ops2", "o2pass")).status == 403
+    assert call("POST", "/_aliases", add_secret, headers=ELASTIC).status == 200
+
+
+def test_scripts_stay_cluster_scoped():
+    """Stored scripts are cluster metadata (ref: cluster:admin/script/put):
+    _scripts classifies as a CLUSTER action, unlike _reindex/_aliases
+    which name data indices in their bodies."""
+    from elasticsearch_tpu.rest.controller import RestRequest
+    from elasticsearch_tpu.security.service import _classify
+
+    req = RestRequest(method="PUT", path="/_scripts/s1", params={},
+                      body={"script": {"lang": "painless", "source": "1"}},
+                      raw_body=b"", headers={})
+    kind, priv, indices = _classify(req, ["_scripts", "s1"])
+    assert kind == "cluster" and indices is None
+
+    # ...while _reindex demands read(source) + write(dest) on the body's
+    # indices, and _aliases demands manage on each named index
+    req = RestRequest(method="POST", path="/_reindex", params={},
+                      body={"source": {"index": ["a", "b"]},
+                            "dest": {"index": "c"}},
+                      raw_body=b"", headers={})
+    kind, priv, _ = _classify(req, ["_reindex"])
+    assert kind == "multi"
+    assert ("read", ["a", "b"]) in priv and ("write", ["c"]) in priv
+
+    req = RestRequest(method="POST", path="/_aliases", params={},
+                      body={"actions": [
+                          {"add": {"index": "x", "alias": "al"}},
+                          {"remove": {"indices": ["y", "z"], "alias": "al"}},
+                      ]}, raw_body=b"", headers={})
+    kind, priv, indices = _classify(req, ["_aliases"])
+    assert (kind, priv, indices) == ("index", "manage", ["x", "y", "z"])
+
+
 def test_security_disabled_by_default_stays_open():
     node = Node()
     rc = RestController()

@@ -1,0 +1,411 @@
+"""Named bounded executors + TPU dispatch coalescer (threadpool/).
+
+Admission control: saturating one named pool rejects with 429
+`es_rejected_execution_exception` (pool name in the reason) without
+affecting the other pools. Coalescing: concurrent single-query searches
+on the same engine merge into ONE device dispatch whose de-multiplexed
+rows are BIT-identical to solo execution — across turbo and blockmax
+engines, and under a mid-window snapshot refresh (engine swap).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.threadpool import (
+    DispatchCoalescer, EsRejectedExecutionError, ThreadPool,
+    default_coalescer, pool_for_request,
+)
+
+WORDS = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+         "theta", "iota", "kappa", "lam", "mu", "nu", "xi", "omicron", "pi"]
+
+QUERIES = [["alpha"], ["beta", "gamma"], ["delta"], ["pi", "omicron"],
+           ["mu", "nu", "xi"], ["kappa"], ["theta", "iota"], ["zeta", "eta"]]
+
+
+def tiny_pool(**overrides):
+    sizes = {"search": 1, "write": 1, "get": 1, "management": 1,
+             "snapshot": 1}
+    queues = {"search": 1, "write": 1, "get": 1, "management": 1,
+              "snapshot": 1}
+    sizes.update(overrides.get("sizes", {}))
+    queues.update(overrides.get("queues", {}))
+    return ThreadPool(sizes=sizes, queue_sizes=queues)
+
+
+# ---------------------------------------------------------------------------
+# named pools: submission, stats, rejection, isolation
+# ---------------------------------------------------------------------------
+
+
+def test_submit_executes_and_counts():
+    pool = ThreadPool(sizes={"search": 2})
+    try:
+        tasks = [pool.submit("search", lambda x: x * 2, i) for i in range(8)]
+        assert [t.get(timeout=10) for t in tasks] == [i * 2 for i in range(8)]
+        st = pool.stats()["search"]
+        assert st["completed"] == 8
+        assert st["queue"] == 0 and st["active"] == 0
+        assert 1 <= st["largest"] <= 2
+        assert st["ewma_ms"] >= 0.0
+    finally:
+        pool.shutdown()
+
+
+def test_saturated_pool_rejects_with_429_and_pool_name():
+    pool = tiny_pool()
+    release = threading.Event()
+    try:
+        running = pool.submit("search", release.wait, 10)   # occupies the worker
+        time.sleep(0.05)
+        queued = pool.submit("search", lambda: "queued")    # fills the queue
+        with pytest.raises(EsRejectedExecutionError) as ei:
+            pool.submit("search", lambda: "rejected")
+        assert ei.value.status == 429
+        assert ei.value.error_type == "es_rejected_execution_exception"
+        assert "search" in str(ei.value)
+        assert pool.stats()["search"]["rejected"] == 1
+        # the REST error body carries the type the clients retry on
+        assert ei.value.to_dict()["type"] == "es_rejected_execution_exception"
+        release.set()
+        assert queued.get(timeout=10) == "queued"
+        assert running.get(timeout=10) is True
+    finally:
+        release.set()
+        pool.shutdown()
+
+
+def test_write_saturation_does_not_reject_searches():
+    pool = tiny_pool()
+    release = threading.Event()
+    try:
+        pool.submit("write", release.wait, 10)
+        time.sleep(0.05)
+        pool.submit("write", lambda: None)                  # queue full now
+        with pytest.raises(EsRejectedExecutionError):
+            pool.submit("write", lambda: None)
+        # the search stage is a different bounded pool: unaffected
+        assert pool.submit("search", lambda: "ok").get(timeout=10) == "ok"
+        assert pool.stats()["search"]["rejected"] == 0
+        assert pool.stats()["write"]["rejected"] == 1
+    finally:
+        release.set()
+        pool.shutdown()
+
+
+def test_execute_reenters_inline_from_own_worker():
+    """A stage calling itself must run inline, not wait on its own
+    single-worker pool (self-deadlock under saturation)."""
+    pool = tiny_pool()
+    try:
+        def nested():
+            return pool.execute("search", lambda: "inner")
+
+        assert pool.execute("search", nested) == "inner"
+    finally:
+        pool.shutdown()
+
+
+def test_task_errors_propagate_to_waiter():
+    pool = ThreadPool(sizes={"management": 1})
+    try:
+        def boom():
+            raise ValueError("broken task")
+
+        with pytest.raises(ValueError, match="broken task"):
+            pool.execute("management", boom)
+        assert pool.stats()["management"]["completed"] == 1
+    finally:
+        pool.shutdown()
+
+
+def test_pool_for_request_classification():
+    assert pool_for_request("POST", "/idx/_search") == "search"
+    assert pool_for_request("GET", "/_msearch") == "search"
+    assert pool_for_request("POST", "/idx/_bulk") == "write"
+    assert pool_for_request("POST", "/_reindex") == "write"
+    assert pool_for_request("GET", "/idx/_doc/1") == "get"
+    assert pool_for_request("PUT", "/idx/_doc/1") == "write"
+    assert pool_for_request("GET", "/idx/_source/1") == "get"
+    assert pool_for_request("PUT", "/_snapshot/repo/snap") == "snapshot"
+    assert pool_for_request("GET", "/_cluster/health") == "management"
+    assert pool_for_request("GET", "/") == "management"
+
+
+def test_http_server_sheds_load_with_429():
+    """End to end: a saturated search pool answers 429 with
+    es_rejected_execution_exception while management keeps serving."""
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import (
+        HttpServer, RestController, register_handlers,
+    )
+
+    node = Node()
+    pool = tiny_pool()
+    node.thread_pool.shutdown()
+    node.thread_pool = pool          # stats routes report the live pool
+    rc = RestController()
+    register_handlers(node, rc)
+    release = threading.Event()
+    started = threading.Event()
+
+    def slow_search(req):
+        from elasticsearch_tpu.rest.controller import RestResponse
+
+        started.set()
+        release.wait(10)
+        return RestResponse(body={"slow": True})
+
+    rc.register("GET", "/_slowtest/_search", slow_search)
+    server = HttpServer(rc, port=0, thread_pool=pool)
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def http(path):
+        try:
+            with urllib.request.urlopen(base + path, timeout=15) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    try:
+        t1 = threading.Thread(target=http, args=("/_slowtest/_search",))
+        t1.start()
+        assert started.wait(10)
+        t2 = threading.Thread(target=http, args=("/_slowtest/_search",))
+        t2.start()                       # sits in the queue (capacity 1)
+        deadline = time.monotonic() + 5
+        while pool.stats()["search"]["queue"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        status, body = http("/_slowtest/_search")
+        assert status == 429
+        assert body["error"]["type"] == "es_rejected_execution_exception"
+        assert "search" in body["error"]["reason"]
+        # management pool unaffected: the cat route still answers and
+        # reports the rejection
+        status, _ = http("/_cluster/health")
+        assert status == 200
+        with urllib.request.urlopen(base + "/_cat/thread_pool/search",
+                                    timeout=15) as resp:
+            line = resp.read().decode()
+        assert line.split() == [node.node_name, "search", "1", "1", "1"]
+    finally:
+        release.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        server.stop()
+        pool.shutdown()
+        node.close()
+
+
+# ---------------------------------------------------------------------------
+# dispatch coalescer: bit-identity with solo execution
+# ---------------------------------------------------------------------------
+
+
+def _build_index(monkeypatch, *, turbo: bool, uuid: str):
+    from elasticsearch_tpu.cluster.state import IndexMetadata
+    from elasticsearch_tpu.common.settings import Settings
+    from elasticsearch_tpu.index.index_service import IndexService
+
+    if turbo:
+        monkeypatch.setenv("ES_TPU_FORCE_TURBO", "1")
+        monkeypatch.setenv("ES_TPU_TURBO_COLD_DF", "8")
+    meta = IndexMetadata(
+        index="co_" + uuid, uuid=uuid, settings=Settings({}),
+        mappings={"properties": {"body": {"type": "text"}}})
+    svc = IndexService(meta)
+    rng = np.random.default_rng(99)
+    for i in range(320):
+        words = rng.choice(WORDS, size=int(rng.integers(3, 16)))
+        svc.index_doc(str(i), {"body": " ".join(words)})
+        if i == 140:
+            svc.refresh()
+    for i in range(0, 50, 9):
+        svc.delete_doc(str(i))
+    svc.refresh()
+    return svc
+
+
+def _concurrent_dispatch(co, eng, queries, k=10):
+    """Each query on its own thread, all released together."""
+    results = [None] * len(queries)
+    errors = []
+    barrier = threading.Barrier(len(queries))
+
+    def worker(i, q):
+        try:
+            barrier.wait(timeout=10)
+            results[i] = co.dispatch(eng, [q], k)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i, q))
+               for i, q in enumerate(queries)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    return results
+
+
+def _assert_rows_equal(got, want, ctx):
+    gs, gp, go = got
+    ws, wp, wo = want
+    assert np.array_equal(gs, ws), ctx
+    assert np.array_equal(gp, wp), ctx
+    assert np.array_equal(go, wo), ctx
+
+
+@pytest.mark.parametrize("turbo", [True, False], ids=["turbo", "blockmax"])
+def test_coalesced_rows_bit_identical_to_solo(monkeypatch, turbo):
+    svc = _build_index(monkeypatch, turbo=turbo, uuid="u_co1" + str(turbo))
+    try:
+        eng = svc.serving.snapshot().engine("body")
+        assert eng.kind == ("turbo" if turbo else "blockmax")
+        solo = [eng.search_many([[q]], k=10)[0] for q in QUERIES]
+        co = DispatchCoalescer(window_us=500_000, max_batch=len(QUERIES))
+        results = _concurrent_dispatch(co, eng, QUERIES)
+        for q, got, want in zip(QUERIES, results, solo):
+            _assert_rows_equal(
+                (got[0][0], got[1][0], got[2][0]),
+                (want[0][0], want[1][0], want[2][0]), q)
+        st = co.stats()
+        assert st["coalesced_queries"] == len(QUERIES)
+        # merging actually happened (a full barrier + 500ms window makes
+        # fewer dispatches than queries all but certain)
+        assert st["coalesced_dispatches"] < len(QUERIES)
+        assert st["largest_batch"] > 1
+    finally:
+        svc.close()
+
+
+def test_coalescer_keys_by_k_and_window_zero_disables(monkeypatch):
+    svc = _build_index(monkeypatch, turbo=False, uuid="u_co2")
+    try:
+        eng = svc.serving.snapshot().engine("body")
+        co = DispatchCoalescer(window_us=0)
+        s, p, o = co.dispatch(eng, [["alpha"]], 10)
+        want_s, want_p, want_o = eng.search_many([[["alpha"]]], k=10)[0]
+        _assert_rows_equal((s[0], p[0], o[0]),
+                           (want_s[0], want_p[0], want_o[0]), "win0")
+        assert co.stats()["coalesced_dispatches"] == 0
+        assert co.stats()["direct_dispatches"] == 1
+
+        # different k values never share a device dispatch
+        co2 = DispatchCoalescer(window_us=50_000)
+        out = {}
+
+        def run(k):
+            out[k] = co2.dispatch(eng, [["beta", "gamma"]], k)
+
+        ts = [threading.Thread(target=run, args=(k,)) for k in (5, 10)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        for k in (5, 10):
+            want = eng.search_many([[["beta", "gamma"]]], k=k)[0]
+            _assert_rows_equal((out[k][0][0], out[k][1][0], out[k][2][0]),
+                               (want[0][0], want[1][0], want[2][0]), k)
+            assert out[k][0].shape == (1, k)
+    finally:
+        svc.close()
+
+
+def test_mid_window_engine_swap_keeps_batches_separate(monkeypatch):
+    """A snapshot refresh mid-window swaps the engine object: waiters on
+    the OLD engine finish on the snapshot they captured, new arrivals key
+    onto the new engine — both bit-identical to solo execution."""
+    svc = _build_index(monkeypatch, turbo=True, uuid="u_co3")
+    try:
+        snap1 = svc.serving.snapshot()
+        eng1 = snap1.engine("body")
+        solo1 = eng1.search_many([[["alpha"]]], k=10)[0]
+
+        co = DispatchCoalescer(window_us=400_000)
+        got1 = {}
+
+        def old_engine_waiter():
+            got1["rows"] = co.dispatch(eng1, [["alpha"]], 10)
+
+        t = threading.Thread(target=old_engine_waiter)
+        t.start()
+        deadline = time.monotonic() + 5       # old-engine batch is pending
+        while co.stats()["coalesced_dispatches"] == 0 \
+                and not co._pending and time.monotonic() < deadline:
+            time.sleep(0.005)
+
+        # refresh swaps the serving snapshot -> NEW engine object
+        svc.index_doc("new", {"body": "alpha alpha alpha fresh"})
+        svc.refresh()
+        snap2 = svc.serving.snapshot()
+        eng2 = snap2.engine("body")
+        assert eng2 is not eng1
+        rows2 = co.dispatch(eng2, [["alpha"]], 10)
+        t.join(timeout=60)
+
+        _assert_rows_equal(
+            (got1["rows"][0][0], got1["rows"][1][0], got1["rows"][2][0]),
+            (solo1[0][0], solo1[1][0], solo1[2][0]), "old engine")
+        solo2 = eng2.search_many([[["alpha"]]], k=10)[0]
+        _assert_rows_equal((rows2[0][0], rows2[1][0], rows2[2][0]),
+                           (solo2[0][0], solo2[1][0], solo2[2][0]),
+                           "new engine")
+        assert co.stats()["coalesced_dispatches"] == 2
+    finally:
+        svc.close()
+
+
+def test_serving_path_coalesces_concurrent_searches(monkeypatch):
+    """End to end through ServingContext.try_search: concurrent REST-level
+    singles produce the same responses as sequential solo execution, and
+    the process-default coalescer reports merged device dispatches."""
+    svc = _build_index(monkeypatch, turbo=True, uuid="u_co4")
+    try:
+        bodies = [{"query": {"match": {"body": " ".join(q)}}}
+                  for q in QUERIES]
+        monkeypatch.setenv("ES_TPU_COALESCE_US", "0")
+        want = [svc.serving.try_search(b, "query_then_fetch")
+                for b in bodies]
+        assert all(w is not None for w in want)
+
+        monkeypatch.setenv("ES_TPU_COALESCE_US", "300000")
+        before = default_coalescer().stats()["coalesced_dispatches"]
+        got = [None] * len(bodies)
+        errors = []
+        barrier = threading.Barrier(len(bodies))
+
+        def worker(i, b):
+            try:
+                barrier.wait(timeout=10)
+                got[i] = svc.serving.try_search(b, "query_then_fetch")
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i, b))
+                   for i, b in enumerate(bodies)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+        merged = default_coalescer().stats()["coalesced_dispatches"] - before
+        assert 1 <= merged < len(bodies)
+        for b, g, w in zip(bodies, got, want):
+            assert g is not None, b
+            assert [h["_id"] for h in g["hits"]["hits"]] == \
+                [h["_id"] for h in w["hits"]["hits"]], b
+            assert [h["_score"] for h in g["hits"]["hits"]] == \
+                [h["_score"] for h in w["hits"]["hits"]], b
+            assert g["hits"]["total"] == w["hits"]["total"], b
+    finally:
+        svc.close()
