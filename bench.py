@@ -11,7 +11,12 @@ Engine: config 1 runs through `select_bm25_engine` — the SAME selection
 logic the REST serving path uses (search/serving.py; VERDICT r4 item 2) —
 which picks TurboBM25 (int8 column cache + Pallas, parallel/turbo.py) when
 the colizable column set fits the HBM budget and BlockMaxBM25 otherwise.
-The JSON reports which engine served.
+Every config reports the engine kind that ACTUALLY served it plus that
+engine's counter movement across the config (`engine_stats` delta), so
+turbo-vs-blockmax attribution in configs 2/3 is read from the JSON, not
+inferred. With S > 1 partitions on a multi-device mesh the turbo engine
+runs one fused shard_map dispatch and a device-side partition merge
+(`turbo_fused` in the JSON; merge_device/partition_dispatches counters).
 
 Budget discipline (VERDICT r4 item 1 — rc=124 twice is worse than any
 number): the process watches a wall-clock budget (env BENCH_BUDGET_S,
@@ -197,6 +202,36 @@ class _Seg:
 
 def pct(xs, q):
     return float(np.percentile(np.asarray(xs), q)) * 1000.0
+
+
+def engine_stats(engine):
+    """Cumulative engine counters as a plain dict, or None when the
+    engine exposes none (BlockMax has no stats surface)."""
+    st = getattr(engine, "stats", None)
+    if callable(st):
+        st = st()
+    if not isinstance(st, dict):
+        return None
+    return {k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in st.items()}
+
+
+def stats_delta(before, after):
+    """What a config ACTUALLY consumed: counter movement across its run
+    (warmup included — faulting columns in is part of serving it)."""
+    if after is None:
+        return None
+    if before is None:
+        return after
+    out = {}
+    for k, v in after.items():
+        b = before.get(k)
+        if isinstance(v, (int, float)) and isinstance(b, (int, float)):
+            d = v - b
+            out[k] = round(d, 3) if isinstance(d, float) else d
+        else:
+            out[k] = v
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -400,6 +435,12 @@ def main():
     detail["stack_device_s"] = round(time.time() - t0, 1)
     detail["hbm_index_bytes"] = int(eng.hbm_bytes())
     if eng.kind == "turbo":
+        detail["n_partitions"] = len(eng.turbos)
+        # S > 1 on a multi-device mesh serves all partitions as ONE fused
+        # shard_map dispatch with a device-side merge (parallel/turbo.py
+        # ShardedTurbo); S == 1 keeps the solo dispatch path
+        detail["turbo_fused"] = eng.mesh is not None
+    if eng.kind == "turbo":
         avgdl = eng.turbos[0]._avgdl
         total_docs = eng.turbos[0]._total_docs
     else:
@@ -419,6 +460,7 @@ def main():
 
     # ================= config 1: match =================
     log(f"config1 warmup ({eng.kind})...")
+    st_c1 = engine_stats(eng)
     t0 = time.time()
     if eng.kind == "turbo":
         detail["n_columns"] = eng.prebuild_columns()   # no builds in timing
@@ -481,12 +523,14 @@ def main():
             f"sparse-posting-merge-numpy on all granted cores "
             f"(nproc={os.cpu_count()})",
     })
-    if eng.kind == "turbo":
-        c1["engine_stats"] = {k_: round(v, 3) if isinstance(v, float) else v
-                              for k_, v in eng.stats.items()}
+    c1["engine"] = eng.kind
+    es_c1 = stats_delta(st_c1, engine_stats(eng))
+    if es_c1 is not None:
+        c1["engine_stats"] = es_c1
     RESULT["value"] = round(match_qps, 1)
     RESULT["vs_baseline"] = round(match_qps / cpu_match_qps, 2)
-    log(f"config1: {match_qps:.1f} qps, {RESULT['vs_baseline']}x cpu, "
+    log(f"config1 ({eng.kind}): {match_qps:.1f} qps, "
+        f"{RESULT['vs_baseline']}x cpu, "
         f"agreement {match_agree}, p95(1) {c1['latency_ms_batch1_p95']}ms")
 
     # ===== config1_concurrent: dispatch coalescer under open client load ==
@@ -680,6 +724,7 @@ def main():
                 return out
 
             bool_qs = draw_bool(QUERIES)
+            st_c2 = engine_stats(bmx2)
             # warmup: the timed set itself — compiles every shape AND (for
             # turbo) faults the must/filter presence columns into the LRU,
             # so the timed pass measures serving steady state
@@ -691,7 +736,7 @@ def main():
             t0 = time.time()
             cpu_bool = [cpu.search_bool(q) for q in bool_qs[:n_cpu]]
             cpu_bool_qps = n_cpu / (time.time() - t0)
-            detail["config2_bool"] = {
+            c2 = {
                 "engine": bmx2.kind,
                 "qps": round(QUERIES / bool_wall, 1),
                 "cpu_qps": round(cpu_bool_qps, 1),
@@ -700,6 +745,12 @@ def main():
                     agreement((b_s, b_o), cpu_bool, n_cpu, rtol=2e-5), 4),
                 "agreement_sample": n_cpu,
             }
+            es_c2 = stats_delta(st_c2, engine_stats(bmx2))
+            if es_c2 is not None:
+                c2["engine_stats"] = es_c2
+            detail["config2_bool"] = c2
+            log(f"config2 ({bmx2.kind}): {QUERIES / bool_wall:.1f} qps, "
+                f"agreement {c2['top10_agreement']}")
         except Exception as e:   # noqa: BLE001
             detail["config2_bool"] = {"error": repr(e)[:300]}
     else:
@@ -737,6 +788,7 @@ def main():
                 # positional executor
                 bmx3 = (eng if eng.kind == "turbo" and slop == 0
                         else blockmax_engine())
+                st_c3 = engine_stats(bmx3)
                 # warmup: compile shapes + (turbo) build adjacency columns
                 bmx3.search_phrase(phrases, k=K, slop=slop)
                 t0 = time.time()
@@ -746,7 +798,7 @@ def main():
                 cpu_res = [cpu_phrase.search(q, slop=slop)
                            for q in phrases[:n_cpu]]
                 cpu_qps = n_cpu / (time.time() - t0)
-                results[f"slop{slop}"] = {
+                r3 = {
                     "engine": bmx3.kind,
                     "qps": round(QUERIES / wall, 1),
                     "cpu_qps": round(cpu_qps, 1),
@@ -755,6 +807,13 @@ def main():
                         agreement((p_s, p_o), cpu_res, n_cpu, rtol=2e-5), 4),
                     "agreement_sample": n_cpu,
                 }
+                es_c3 = stats_delta(st_c3, engine_stats(bmx3))
+                if es_c3 is not None:
+                    r3["engine_stats"] = es_c3
+                results[f"slop{slop}"] = r3
+                log(f"config3 slop{slop} ({bmx3.kind}): "
+                    f"{QUERIES / wall:.1f} qps, "
+                    f"agreement {r3['top10_agreement']}")
             detail["config3_phrase"] = results
         except Exception as e:   # noqa: BLE001
             detail["config3_phrase"] = {"error": repr(e)[:300]}

@@ -312,6 +312,83 @@ ROWS_PER_STEP = 8
 
 
 # --------------------------------------------------------------------------
+# partition-merge kernel
+# --------------------------------------------------------------------------
+
+
+def _merge_kernel(k: int, KP: int):
+    def kernel(s_ref, o_ref, out_s, out_p, out_o):
+        s = s_ref[...]                                    # [QB, L] f32
+        o = o_ref[...]                                    # [QB, L] i32
+        QB = s.shape[0]
+        # lane layout is partition-major: lane = partition * k + slot
+        p = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) // k
+        s = jnp.where(s > 0, s, 0.0)
+        kiota = jax.lax.broadcasted_iota(jnp.int32, (QB, KP), 1)
+        acc_s = jnp.zeros((QB, KP), jnp.float32)
+        acc_p = jnp.zeros((QB, KP), jnp.int32)
+        acc_o = jnp.zeros((QB, KP), jnp.int32)
+        big = jnp.int32(1 << 30)
+        for j in range(k):
+            m = jnp.max(s, axis=1, keepdims=True)         # [QB, 1]
+            at = (s == m) & (m > 0)
+            pmin = jnp.min(jnp.where(at, p, big), axis=1, keepdims=True)
+            at2 = at & (p == pmin)
+            omin = jnp.min(jnp.where(at2, o, big), axis=1, keepdims=True)
+            sel = at2 & (o == omin)
+            keep = (kiota == j) & (m > 0)
+            acc_s = jnp.where(keep, m, acc_s)
+            acc_p = jnp.where(keep, pmin, acc_p)
+            acc_o = jnp.where(keep, omin, acc_o)
+            s = jnp.where(sel, 0.0, s)
+        out_s[...] = acc_s
+        out_p[...] = acc_p
+        out_o[...] = acc_o
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def merge_topk(scores, ords, *, k: int):
+    """Dense deterministic merge of per-partition top-k candidate lanes.
+
+    scores [Q, S*k] f32 — lane = partition * k + slot; non-positive lanes
+        are empty and never selected
+    ords   [Q, S*k] i32 — per-partition doc ordinals aligned with scores
+
+    Selection is a k-step max cascade (the _toprows idiom: XLA sort runs
+    at scalar speed on this TPU, k passes of tiled VPU reductions do not)
+    with the (score desc, partition asc, ord asc) tie-break resolved by
+    two nested min-reductions per step — exactly the host _merge3
+    lexicographic order. Empty output slots are (0, 0, 0).
+    Returns (scores [Q, k] f32, parts [Q, k] i32, ords [Q, k] i32).
+    """
+    Q, L = scores.shape
+    QB = -(-max(Q, 1) // 8) * 8
+    LP = -(-max(L, 1) // 128) * 128
+    KP = -(-k // 128) * 128
+    s = jnp.pad(scores, ((0, QB - Q), (0, LP - L)))
+    o = jnp.pad(ords.astype(jnp.int32), ((0, QB - Q), (0, LP - L)))
+    kernel = _merge_kernel(k, KP)
+    fn = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_shape=[
+            jax.ShapeDtypeStruct((QB, KP), jnp.float32),
+            jax.ShapeDtypeStruct((QB, KP), jnp.int32),
+            jax.ShapeDtypeStruct((QB, KP), jnp.int32),
+        ],
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )
+    out_s, out_p, out_o = fn(s, o)
+    return out_s[:Q, :k], out_p[:Q, :k], out_o[:Q, :k]
+
+
+# --------------------------------------------------------------------------
 # column builder kernel
 # --------------------------------------------------------------------------
 

@@ -462,6 +462,71 @@ def _gather_parts(x):
     return g.reshape((-1,) + x.shape[1:])
 
 
+@partial(jax.jit, static_argnames=("mesh", "k"))
+def _partition_merge_program(scores, ords, *, mesh, k):
+    """Device-side partition top-k merge: all-gather each device's local
+    per-partition (score, ord) lanes over 'shard' (ords ride as _pack_ids
+    f32 lanes), then run the dense merge kernel on every device.
+
+    scores [Sp, Q, k] f32 sharded P('shard') on dim 0 (<= 0 = empty slot)
+    ords   [Sp, Q, k] i32 sharded likewise
+
+    Returns ONE packed [Q, 3, k] f32 array (row 0 scores, rows 1/2 the
+    merged partition/ord ids as _pack_ids lanes) so a single transfer
+    crosses the host link."""
+    from elasticsearch_tpu.parallel.kernels import merge_topk
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("shard"), P("shard")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def program(s, o):
+        g_s = _gather_parts(s)                            # [Sp, Q, k]
+        g_o = _gather_parts(_pack_ids(o))
+        Sp, Q, kk = g_s.shape
+        flat_s = jnp.transpose(g_s, (1, 0, 2)).reshape(Q, Sp * kk)
+        flat_o = jnp.transpose(g_o, (1, 0, 2)).reshape(Q, Sp * kk)
+        flat_o = jnp.bitwise_and(
+            jax.lax.bitcast_convert_type(flat_o, jnp.int32),
+            jnp.int32(_ID_MASK))
+        top_s, top_p, top_o = merge_topk(flat_s, flat_o, k=k)
+        return jnp.stack([top_s, _pack_ids(top_p), _pack_ids(top_o)],
+                         axis=1)
+
+    return program(scores, ords)
+
+
+def merge_partition_topk(mesh: Mesh, scores: np.ndarray, ords: np.ndarray,
+                         k: int):
+    """Merge per-partition top-k results ON DEVICE with the deterministic
+    (score desc, partition asc, ord asc) tie-break — the device twin of
+    serving.TurboEngine._merge3 (bit-identical: merging permutes exact f32
+    score values, it never recomputes them).
+
+    scores [S, Q, k] f32 host array (<= 0 marks an empty slot)
+    ords   [S, Q, k] i32 host array (per-partition doc ordinals < 2**24)
+
+    Returns host (scores [Q, k] f32, parts [Q, k] i32, ords [Q, k] i32);
+    empty output slots are (0, 0, 0)."""
+    G = mesh.shape["shard"]
+    S, Q, kk = scores.shape
+    Sp = -(-S // G) * G
+    if Sp != S:
+        scores = np.concatenate(
+            [scores, np.zeros((Sp - S, Q, kk), scores.dtype)])
+        ords = np.concatenate(
+            [ords, np.zeros((Sp - S, Q, kk), ords.dtype)])
+    packed = np.asarray(_partition_merge_program(
+        jnp.asarray(scores), jnp.asarray(ords.astype(np.int32)),
+        mesh=mesh, k=kk))
+    return (packed[:, 0].copy(),
+            unpack_ids_np(packed[:, 1]),
+            unpack_ids_np(packed[:, 2]))
+
+
 def sharded_bm25_topk(
     mesh: Mesh,
     stacked: StackedBM25,

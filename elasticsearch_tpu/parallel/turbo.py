@@ -46,11 +46,13 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as _P
 
 from elasticsearch_tpu.index.positions import phrase_freqs
 from elasticsearch_tpu.index.segment import tf_at
 from elasticsearch_tpu.ops import bm25_idf
 from elasticsearch_tpu.parallel.blockmax import _host_block_scores
+from elasticsearch_tpu.parallel.compat import shard_map as _shard_map
 from elasticsearch_tpu.parallel.kernels import (
     COLSCALE, COLSCALE2, MAX_GROUP_ROWS, NCAND, ROWS_PER_STEP,
     SW, TILE, build_columns, sweep_rowmax, sweep_rowmax_conj,
@@ -102,6 +104,28 @@ def _pick_rows(rm, rr, *, n_rows: int):
         rows.astype(jnp.float32),
         jnp.maximum(beyond, sw_bound)[:, None],
     ], axis=1)
+_LANE128 = np.arange(128, dtype=np.int64)
+
+
+def _flatten_queries(batches: Sequence[List]):
+    """Flatten batches of term/(term, boost) query lists into
+    (flat [(term, boost)] lists with duplicate terms summed,
+    spans [(offset, count)] per batch) — shared by TurboBM25.search_many
+    and the fused multi-partition path so both dispatch the exact same
+    aggregated weights."""
+    flat: List[List[Tuple[str, float]]] = []
+    spans = []
+    for queries in batches:
+        spans.append((len(flat), len(queries)))
+        for q in queries:
+            agg: Dict[str, float] = {}
+            for t in q:
+                t, b = (t, 1.0) if isinstance(t, str) else t
+                agg[t] = agg.get(t, 0.0) + b
+            flat.append(list(agg.items()))
+    return flat, spans
+
+
 _BUILD_BUCKETS = (256, 1024, 4096, 16384, 32768)   # last one bounded by
 #   SMEM: 4 prefetch arrays x bucket x 4B must stay well under the 1MB SMEM
 
@@ -254,6 +278,10 @@ class TurboBM25:
         # whose lane arrays are long gone
         self._tile_bases: Dict[str, np.ndarray] = {}
         self.force_cert_fail = False   # test hook: exercise the fallback
+        # bumped whenever cols_hi/cols_lo are rebuilt, so the fused
+        # multi-partition cache (ShardedTurbo._refresh) re-syncs only the
+        # partitions whose columns actually changed
+        self.cols_epoch = 0
         self.stats = {"builds": 0, "build_s": 0.0, "fallbacks": 0,
                       "cold_queries": 0, "dispatches": 0, "degraded": 0,
                       "phrase_builds": 0, "bool_host": 0, "bool_device": 0}
@@ -382,6 +410,7 @@ class TurboBM25:
                     [s_p, np.full(pad, self.Hp, np.int32)])),
                 self.lane_docs, self.lane_scores,
                 self.cols_hi, self.cols_lo, n_groups=ng)
+        self.cols_epoch += 1
         self.stats["builds"] += len(need)
         self.stats["build_s"] += time.monotonic() - t0
 
@@ -521,6 +550,7 @@ class TurboBM25:
                     [s_p, np.full(pad, self.Hp, np.int32)])),
                 lane_docs, lane_scores,
                 self.cols_hi, self.cols_lo, n_groups=ng)
+        self.cols_epoch += 1
         self.stats["builds"] += len(need)
         self.stats["phrase_builds"] += len(need)
         self.stats["build_s"] += time.monotonic() - t0
@@ -622,16 +652,7 @@ class TurboBM25:
         (scores [Q, k] f32, ords [Q, k] i32). Queries are term lists or
         (term, boost) lists. check: optional cooperative-cancellation
         callable invoked between dispatches (tasks/task_manager)."""
-        flat: List[List[Tuple[str, float]]] = []
-        spans = []
-        for queries in batches:
-            spans.append((len(flat), len(queries)))
-            for q in queries:
-                agg: Dict[str, float] = {}
-                for t in q:
-                    t, b = (t, 1.0) if isinstance(t, str) else t
-                    agg[t] = agg.get(t, 0.0) + b
-                flat.append(list(agg.items()))
+        flat, spans = _flatten_queries(batches)
         if not flat:
             return [(np.zeros((n, k), np.float32), np.zeros((n, k), np.int32))
                     for _, n in spans]
@@ -665,7 +686,6 @@ class TurboBM25:
         # pass 2: fetch the tiny row sets; EXACT host rescore of every doc
         # in the collected rows (33 rows x 128 lanes x a binary search per
         # query term — ~1ms/query), merged with the cold side
-        lane = np.arange(128, dtype=np.int64)
         out_s = np.zeros((len(flat), k), np.float32)
         out_d = np.zeros((len(flat), k), np.int32)
         for off, n, packed_dev in pending:
@@ -675,11 +695,7 @@ class TurboBM25:
             rows_all = packed[:, :n_rows].astype(np.int64)
             bounds = packed[:, n_rows]
             for qi in range(n):
-                rw = rows_all[qi]
-                rw = rw[rw >= 0]
-                docs = (rw[:, None] * 128 + lane[None, :]).ravel()
-                if len(docs):
-                    docs = docs[self._live_host[docs] > 0]
+                docs = self._collect_docs(rows_all[qi])
                 s, d = self._finish_query(
                     flat[off + qi], docs, float(bounds[qi]), k)
                 out_s[off + qi, : len(s)] = s
@@ -689,10 +705,27 @@ class TurboBM25:
     def search(self, queries: List[List], k: int = 10):
         return self.search_many([queries], k)[0]
 
-    def _sweep(self, chunk, QC):
+    def _collect_docs(self, rw: np.ndarray) -> np.ndarray:
+        """Live doc ids in one query's picked rows ([n_rows] i64, -1 =
+        empty slot) — shared by the solo pass-2 loops and the fused
+        multi-partition path."""
+        rw = rw[rw >= 0]
+        docs = (rw[:, None] * 128 + _LANE128[None, :]).ravel()
+        if len(docs):
+            docs = docs[self._live_host[docs] > 0]
+        return docs
+
+    def _sweep_weights(self, chunk, QC: int):
+        """Quantized disjunctive sweep inputs for one dispatch chunk:
+        (wq [2, QC, Hp+1] i8, qscale [QC, 1] f32). A None entry (a query
+        another partition dispatches but this one does not) leaves an
+        all-zero weight row — the kernel scores query columns
+        independently, so zero rows change nothing for its peers."""
         wq = np.zeros((2, QC, self.Hp + 1), np.int8)
         qscale = np.ones((QC, 1), np.float32)
         for qi, terms in enumerate(chunk):
+            if terms is None:
+                continue
             ws = []
             for t, b in terms:
                 slot = self._slot_of.get(t)
@@ -709,6 +742,10 @@ class TurboBM25:
                 wl = max(-127, min(127, round((w - qs * wh) / qs2)))
                 wq[0, qi, slot] = np.int8(wh)
                 wq[1, qi, slot] = np.int8(wl)
+        return wq, qscale
+
+    def _sweep(self, chunk, QC):
+        wq, qscale = self._sweep_weights(chunk, QC)
         out = sweep_rowmax(jnp.asarray(qscale), self.cols_hi, self.cols_lo,
                            jnp.asarray(wq), self.live, QC=QC, nsw=self.nsw)
         return wq, qscale, out
@@ -908,6 +945,47 @@ class TurboBM25:
                 return False
         return True
 
+    def _ensure_bool(self, resolved: Sequence[Optional[_BoolQuery]]):
+        """Warm term + adjacency columns for the device-candidate queries
+        in a resolved batch (shared by search_bool and the fused
+        multi-partition path)."""
+        ens_terms: List[str] = []
+        ens_phr: List[Tuple[str, ...]] = []
+        pkeys = set()
+        for r in resolved:
+            if r is None or not r.dev_candidate:
+                continue
+            ens_terms += [t for t, _, _ in r.conj]
+            ens_terms += [t for t, _ in r.filters]
+            ens_terms += [t for t, _, i in r.should
+                          if i.df >= self.cold_df]
+            ens_terms += [t for t, i in r.must_not
+                          if i.df >= self.cold_df]
+            for terms, _, _, pinfo, _ in r.phrases:
+                if pinfo is not None:
+                    ens_phr.append(pinfo.terms)
+                    pkeys.add(pinfo.key)
+        if ens_terms:
+            self.ensure_columns(ens_terms, protect_extra=pkeys)
+        if ens_phr:
+            self.ensure_phrases(ens_phr,
+                                protect_extra=set(ens_terms) | pkeys)
+
+    def _bool_routes(self, resolved: Sequence[Optional[_BoolQuery]]):
+        """(device_idx, host_idx) routing AFTER columns are ensured —
+        device iff the query is a device candidate and every required
+        column is resident NOW."""
+        device_idx: List[int] = []
+        host_idx: List[int] = []
+        for qi, r in enumerate(resolved):
+            if r is None:
+                continue
+            if r.dev_candidate and self._bool_resident(r):
+                device_idx.append(qi)
+            else:
+                host_idx.append(qi)
+        return device_idx, host_idx
+
     def _bool_slots(self, r: _BoolQuery):
         """(scoring [(slot, w, smax)], required slots, must_not slots)
         over columns resident NOW — the single source of what _sweep_bool
@@ -948,12 +1026,20 @@ class TurboBM25:
         scoring = [(s, w, smax[s]) for s, w in ws.items() if w != 0.0]
         return scoring, req, mn
 
-    def _sweep_bool(self, chunk: Sequence[_BoolQuery], QC: int):
+    def _bool_weights(self, chunk, QC: int):
+        """Quantized conjunctive sweep inputs for one dispatch chunk:
+        (wq [2, QC, Hp+1] i8, wp [QC, Hp+1] i8, nreq [QC, 1] i32,
+        qscale [QC, 1] f32). A None entry (a query this partition routes
+        to host while a fused peer dispatches it) leaves all-zero rows:
+        nreq 0 keeps the coverage test vacuous and zero weights score 0
+        (-inf after the positivity mask), so the row never surfaces."""
         wq = np.zeros((2, QC, self.Hp + 1), np.int8)
         wp = np.zeros((QC, self.Hp + 1), np.int8)
         nreq = np.zeros((QC, 1), np.int32)
         qscale = np.ones((QC, 1), np.float32)
         for qi, r in enumerate(chunk):
+            if r is None:
+                continue
             scoring, req, mn = self._bool_slots(r)
             nreq[qi, 0] = len(req)
             for s in req:
@@ -974,6 +1060,10 @@ class TurboBM25:
                 wl = max(-127, min(127, round((w - qs * wh) / qs2)))
                 wq[0, qi, slot] = np.int8(wh)
                 wq[1, qi, slot] = np.int8(wl)
+        return wq, wp, nreq, qscale
+
+    def _sweep_bool(self, chunk: Sequence[_BoolQuery], QC: int):
+        wq, wp, nreq, qscale = self._bool_weights(chunk, QC)
         return sweep_rowmax_conj(
             jnp.asarray(qscale), jnp.asarray(nreq), self.cols_hi,
             self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
@@ -1173,38 +1263,8 @@ class TurboBM25:
         out_s = np.zeros((Q, k), np.float32)
         out_d = np.zeros((Q, k), np.int32)
         resolved = [self._resolve_bool(spec) for spec in queries]
-
-        ens_terms: List[str] = []
-        ens_phr: List[Tuple[str, ...]] = []
-        pkeys = set()
-        for r in resolved:
-            if r is None or not r.dev_candidate:
-                continue
-            ens_terms += [t for t, _, _ in r.conj]
-            ens_terms += [t for t, _ in r.filters]
-            ens_terms += [t for t, _, i in r.should
-                          if i.df >= self.cold_df]
-            ens_terms += [t for t, i in r.must_not
-                          if i.df >= self.cold_df]
-            for terms, _, _, pinfo, _ in r.phrases:
-                if pinfo is not None:
-                    ens_phr.append(pinfo.terms)
-                    pkeys.add(pinfo.key)
-        if ens_terms:
-            self.ensure_columns(ens_terms, protect_extra=pkeys)
-        if ens_phr:
-            self.ensure_phrases(ens_phr,
-                                protect_extra=set(ens_terms) | pkeys)
-
-        device_idx: List[int] = []
-        host_idx: List[int] = []
-        for qi, r in enumerate(resolved):
-            if r is None:
-                continue
-            if r.dev_candidate and self._bool_resident(r):
-                device_idx.append(qi)
-            else:
-                host_idx.append(qi)
+        self._ensure_bool(resolved)
+        device_idx, host_idx = self._bool_routes(resolved)
         self.stats["bool_device"] += len(device_idx)
 
         # device pipeline (same two-pass shape as search_many)
@@ -1224,7 +1284,6 @@ class TurboBM25:
             off += len(sel)
         self.stats["dispatches"] += len(pending)
 
-        lane = np.arange(128, dtype=np.int64)
         for sel, packed_dev in pending:
             if check is not None:
                 check()
@@ -1232,11 +1291,7 @@ class TurboBM25:
             rows_all = packed[:, :n_rows].astype(np.int64)
             bounds = packed[:, n_rows]
             for j, qi in enumerate(sel):
-                rw = rows_all[j]
-                rw = rw[rw >= 0]
-                docs = (rw[:, None] * 128 + lane[None, :]).ravel()
-                if len(docs):
-                    docs = docs[self._live_host[docs] > 0]
+                docs = self._collect_docs(rows_all[j])
                 s, d = self._finish_bool(resolved[qi], docs,
                                          float(bounds[j]), k)
                 out_s[qi, : len(s)] = s
@@ -1255,3 +1310,262 @@ class TurboBM25:
         over search_bool; slop-0 phrases ride the adjacency columns."""
         specs = [{"phrases": [(list(p), slop, 1.0)]} for p in phrases]
         return self.search_bool(specs, k=k, check=check)
+
+
+# --------------------------------------------------------------------------
+# fused multi-partition dispatch (ICI-sharded S > 1)
+# --------------------------------------------------------------------------
+
+
+@_partial(jax.jit, static_argnames=("mesh", "QC", "nsw", "n_rows"))
+def _fused_sweep_disj(qscale, cols_hi, cols_lo, wq, live, *,
+                      mesh, QC: int, nsw: int, n_rows: int):
+    """ONE launch, every partition: disjunctive sweep + row pick over the
+    partition-sharded fused column cache. All inputs carry the partition
+    axis on dim 0, sharded P('shard'):
+
+    qscale [Sp, QC, 1] f32 · cols_hi/lo [Sp, dpc, Hpt, 16, 128] i8 ·
+    wq [Sp, 2, QC, Hpt] i8 · live [Sp, dp_rows, 128] f32
+
+    Returns [Sp, QC, n_rows + 1] f32 — per partition, exactly the
+    _pick_rows packing a solo dispatch would produce (padding partitions
+    and padded superwindows are dead: live 0 ⇒ -inf ⇒ rows -1, bound 0).
+    """
+    spec = _P("shard")
+
+    @_partial(_shard_map, mesh=mesh, in_specs=(spec,) * 5,
+              out_specs=spec, check_vma=False)
+    def program(qs, ch, cl, w, lv):
+        outs = []
+        for i in range(qs.shape[0]):    # static local-partition loop
+            rm, rr = sweep_rowmax(qs[i], ch[i], cl[i], w[i], lv[i],
+                                  QC=QC, nsw=nsw)
+            outs.append(_pick_rows(rm, rr, n_rows=n_rows))
+        return jnp.stack(outs)
+
+    return program(qscale, cols_hi, cols_lo, wq, live)
+
+
+@_partial(jax.jit, static_argnames=("mesh", "QC", "nsw", "n_rows"))
+def _fused_sweep_bool(qscale, nreq, cols_hi, cols_lo, wq, wp, live, *,
+                      mesh, QC: int, nsw: int, n_rows: int):
+    """Conjunctive twin of _fused_sweep_disj (adds the coverage inputs
+    nreq [Sp, QC, 1] i32 and wp [Sp, QC, Hpt] i8)."""
+    spec = _P("shard")
+
+    @_partial(_shard_map, mesh=mesh, in_specs=(spec,) * 7,
+              out_specs=spec, check_vma=False)
+    def program(qs, nr, ch, cl, w, p, lv):
+        outs = []
+        for i in range(qs.shape[0]):
+            rm, rr = sweep_rowmax_conj(qs[i], nr[i], ch[i], cl[i], w[i],
+                                       p[i], lv[i], QC=QC, nsw=nsw)
+            outs.append(_pick_rows(rm, rr, n_rows=n_rows))
+        return jnp.stack(outs)
+
+    return program(qscale, nreq, cols_hi, cols_lo, wq, wp, live)
+
+
+class ShardedTurbo:
+    """S > 1 TurboBM25 partitions fused into ONE device dispatch per
+    query chunk (the paper's ICI-sharded serving design): each
+    partition's int8 column cache is padded to shared (nsw, Hp) maxima,
+    stacked on dim 0 and placed across the mesh's 'shard' axis — the
+    spmd._put_sharded placement discipline — so the per-partition sweep
+    and row pick run data-parallel over ICI instead of S sequential
+    launches. Padding is provably inert: dead superwindows/partitions
+    have live == 0 and zero columns, so every padded score is -inf and
+    every real (query, partition) output is bit-identical to a solo
+    dispatch (the kernels compute query columns independently).
+
+    The exact host rescore + certificate (and any host-exact fallback)
+    still run per partition on host — this class returns per-partition
+    (scores, ords) shaped exactly like `[t.search_*(..) for t in turbos]`
+    so serving.TurboEngine can merge either on host (_merge3) or on
+    device (spmd.merge_partition_topk)."""
+
+    def __init__(self, turbos: Sequence[TurboBM25], mesh):
+        assert len(turbos) > 1, "fusion needs S > 1 partitions"
+        assert mesh.shape.get("dp", 1) == 1, \
+            "fused turbo shards partitions over 'shard' only"
+        self.turbos = list(turbos)
+        self.mesh = mesh
+        G = mesh.shape["shard"]
+        S = len(turbos)
+        self.Sp = -(-S // G) * G          # padded partition count
+        self.nsw = max(t.nsw for t in turbos)
+        self.Hp = max(t.Hp for t in turbos)
+        self.qc_sizes = turbos[0].qc_sizes
+        dp_rows = self.nsw * (SW // 128)
+        dpc = dp_rows // 16
+        sh = NamedSharding(mesh, _P("shard"))
+        lv = np.zeros((self.Sp, dp_rows, 128), np.float32)
+        for i, t in enumerate(turbos):
+            lv[i, : t.dp_rows] = t._live_host.reshape(t.dp_rows, 128)
+        self.live = jax.device_put(lv, sh)
+        zeros = np.zeros((self.Sp, dpc, self.Hp + 1, 16, 128), np.int8)
+        self.cols_hi = jax.device_put(zeros, sh)
+        self.cols_lo = jax.device_put(zeros, sh)
+        self._sharding = sh
+        self._epochs = [-1] * S
+        self.fused_dispatches = 0
+
+    def _refresh(self) -> None:
+        """Re-sync fused column slices for partitions whose caches were
+        rebuilt since the last dispatch (cols_epoch discipline)."""
+        for i, t in enumerate(self.turbos):
+            if self._epochs[i] == t.cols_epoch:
+                continue
+            a, b = t.cols_hi.shape[0], t.cols_hi.shape[1]
+            self.cols_hi = jax.device_put(
+                self.cols_hi.at[i, :a, :b].set(t.cols_hi), self._sharding)
+            self.cols_lo = jax.device_put(
+                self.cols_lo.at[i, :a, :b].set(t.cols_lo), self._sharding)
+            self._epochs[i] = t.cols_epoch
+
+    def hbm_bytes(self) -> int:
+        return (self.cols_hi.nbytes + self.cols_lo.nbytes
+                + self.live.nbytes)
+
+    # ---------------- fused dispatches ----------------
+
+    def _dispatch_disj(self, chunk, QC: int, n_rows: int):
+        wq = np.zeros((self.Sp, 2, QC, self.Hp + 1), np.int8)
+        qs = np.ones((self.Sp, QC, 1), np.float32)
+        for i, t in enumerate(self.turbos):
+            w, q = t._sweep_weights(chunk, QC)
+            wq[i, :, :, : w.shape[2]] = w
+            qs[i] = q
+        self.fused_dispatches += 1
+        return _fused_sweep_disj(
+            jnp.asarray(qs), self.cols_hi, self.cols_lo, jnp.asarray(wq),
+            self.live, mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
+
+    def _dispatch_bool(self, resolved, dev_sets, sel, QC: int,
+                       n_rows: int):
+        wq = np.zeros((self.Sp, 2, QC, self.Hp + 1), np.int8)
+        wp = np.zeros((self.Sp, QC, self.Hp + 1), np.int8)
+        nreq = np.zeros((self.Sp, QC, 1), np.int32)
+        qs = np.ones((self.Sp, QC, 1), np.float32)
+        for i, t in enumerate(self.turbos):
+            chunk = [resolved[i][qi] if qi in dev_sets[i] else None
+                     for qi in sel]
+            w, p, nr, q = t._bool_weights(chunk, QC)
+            hp = w.shape[2]
+            wq[i, :, :, :hp] = w
+            wp[i, :, :hp] = p
+            nreq[i] = nr
+            qs[i] = q
+        self.fused_dispatches += 1
+        return _fused_sweep_bool(
+            jnp.asarray(qs), jnp.asarray(nreq), self.cols_hi,
+            self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
+            mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
+
+    # ---------------- search ----------------
+
+    def search_many(self, batches: Sequence[List], k: int = 10,
+                    check=None):
+        """per[si][bi] = (scores [Q, k] f32, ords [Q, k] i32) — the same
+        values `self.turbos[si].search_many(batches)` returns solo, but
+        every partition's sweep rides one fused dispatch per chunk."""
+        flat, spans = _flatten_queries(batches)
+        S = len(self.turbos)
+        if not flat:
+            return [[(np.zeros((n, k), np.float32),
+                      np.zeros((n, k), np.int32)) for _, n in spans]
+                    for _ in range(S)]
+        for t in self.turbos:
+            t.ensure_columns(
+                [tm for q in flat for tm, _ in q
+                 if (i := t._term(tm)) is not None and i.df >= t.cold_df])
+        self._refresh()
+        n_rows = max(_GLOBAL_ROWS, k + 5)
+        pending = []
+        off = 0
+        while off < len(flat):
+            rem = len(flat) - off
+            take = next((s for s in self.qc_sizes if s >= rem),
+                        self.qc_sizes[-1])
+            chunk = flat[off: off + take]
+            if check is not None:
+                check()
+            pending.append((off, len(chunk),
+                            self._dispatch_disj(chunk, take, n_rows)))
+            off += len(chunk)
+        out_s = np.zeros((S, len(flat), k), np.float32)
+        out_d = np.zeros((S, len(flat), k), np.int32)
+        for off, n, packed_dev in pending:
+            if check is not None:
+                check()
+            packed = np.asarray(packed_dev)    # [Sp, QC, n_rows + 1]
+            for si, t in enumerate(self.turbos):
+                rows_all = packed[si, :, :n_rows].astype(np.int64)
+                bounds = packed[si, :, n_rows]
+                for qi in range(n):
+                    docs = t._collect_docs(rows_all[qi])
+                    s, d = t._finish_query(flat[off + qi], docs,
+                                           float(bounds[qi]), k)
+                    out_s[si, off + qi, : len(s)] = s
+                    out_d[si, off + qi, : len(d)] = d
+        return [[(out_s[si, o: o + n], out_d[si, o: o + n])
+                 for o, n in spans] for si in range(S)]
+
+    def search_bool(self, queries: Sequence[dict], k: int = 10,
+                    check=None):
+        """per[si] = (scores [Q, k] f32, ords [Q, k] i32), matching each
+        turbo's solo search_bool bitwise. Partitions may route the same
+        query differently (device vs host): the fused sweep dispatches
+        the UNION of device-routed queries with all-zero weight rows for
+        partitions that host-route one — inert because the kernels score
+        query columns independently."""
+        Q = len(queries)
+        S = len(self.turbos)
+        out_s = np.zeros((S, Q, k), np.float32)
+        out_d = np.zeros((S, Q, k), np.int32)
+        resolved = [[t._resolve_bool(spec) for spec in queries]
+                    for t in self.turbos]
+        routes = []
+        for si, t in enumerate(self.turbos):
+            t._ensure_bool(resolved[si])
+            routes.append(t._bool_routes(resolved[si]))
+            t.stats["bool_device"] += len(routes[si][0])
+        self._refresh()
+        dev_sets = [set(dev) for dev, _ in routes]
+        union = sorted({qi for ds in dev_sets for qi in ds})
+        n_rows = max(_GLOBAL_ROWS, k + 5)
+        pending = []
+        off = 0
+        while off < len(union):
+            rem = len(union) - off
+            take = next((s for s in self.qc_sizes if s >= rem),
+                        self.qc_sizes[-1])
+            sel = union[off: off + take]
+            if check is not None:
+                check()
+            pending.append((sel, self._dispatch_bool(
+                resolved, dev_sets, sel, take, n_rows)))
+            off += len(sel)
+        for sel, packed_dev in pending:
+            if check is not None:
+                check()
+            packed = np.asarray(packed_dev)
+            for si, t in enumerate(self.turbos):
+                rows_all = packed[si, :, :n_rows].astype(np.int64)
+                bounds = packed[si, :, n_rows]
+                for j, qi in enumerate(sel):
+                    if qi not in dev_sets[si]:
+                        continue
+                    docs = t._collect_docs(rows_all[j])
+                    s, d = t._finish_bool(resolved[si][qi], docs,
+                                          float(bounds[j]), k)
+                    out_s[si, qi, : len(s)] = s
+                    out_d[si, qi, : len(d)] = d
+        for si, t in enumerate(self.turbos):
+            for qi in routes[si][1]:
+                if check is not None:
+                    check()
+                s, d = t._bool_host_exact(resolved[si][qi], k)
+                out_s[si, qi, : len(s)] = s
+                out_d[si, qi, : len(d)] = d
+        return [(out_s[si], out_d[si]) for si in range(S)]

@@ -1895,6 +1895,7 @@ class _Handlers:
                 "indexing_pressure": self.node.indexing_pressure.stats(),
                 "thread_pool": self.node.thread_pool.stats(),
                 "tpu_coalescer": _default_coalescer_stats(),
+                "tpu_turbo": _turbo_merge_stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
         })
@@ -2183,6 +2184,15 @@ def _default_coalescer_stats() -> dict:
     from elasticsearch_tpu.threadpool.coalescer import default_coalescer
 
     return default_coalescer().stats()
+
+
+def _turbo_merge_stats() -> dict:
+    """Node-wide Turbo partition-merge counters (PR 4): fused S > 1
+    device dispatches, per-partition dispatch units they covered, and
+    how many batch merges ran on device vs through the host _merge3."""
+    from elasticsearch_tpu.search.serving import turbo_node_stats
+
+    return turbo_node_stats()
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

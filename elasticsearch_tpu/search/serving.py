@@ -292,42 +292,115 @@ def _env_cold_df() -> Optional[int]:
     return int(v) if v else None
 
 
+# node-wide Turbo partition-merge counters (every TurboEngine increments
+# these alongside its own merge_stats; GET /_nodes/stats surfaces them
+# next to the tpu_coalescer section)
+_TURBO_NODE_STATS = {"merge_device": 0, "merge_host": 0,
+                     "partition_dispatches": 0, "fused_dispatches": 0}
+_TURBO_NODE_LOCK = threading.Lock()
+
+
+def turbo_node_stats() -> dict:
+    with _TURBO_NODE_LOCK:
+        return dict(_TURBO_NODE_STATS)
+
+
+def _turbo_mesh(n_partitions: int):
+    """Mesh for the fused multi-partition Turbo path: partitions spread
+    data-parallel over the 'shard' axis of a dp=1 mesh covering up to
+    ES_TPU_TURBO_MESH devices (default: all visible; more devices than
+    partitions are left idle). None disables fusion entirely — for S < 2
+    there is nothing to fuse, and ES_TPU_TURBO_MESH=0 is the explicit
+    escape hatch back to the sequential + host-_merge3 path."""
+    if n_partitions < 2:
+        return None
+    import jax
+
+    from elasticsearch_tpu.parallel.spmd import make_mesh
+
+    n = len(jax.devices())
+    v = os.environ.get("ES_TPU_TURBO_MESH")
+    if v:
+        try:
+            n = min(n, int(v))
+        except ValueError:
+            pass
+        if n <= 0:
+            return None
+    return make_mesh(min(n, n_partitions), dp=1)
+
+
 class TurboEngine:
     """Adapter giving per-partition TurboBM25 engines the same
-    (scores, partition, ord) search_many contract as BlockMaxBM25, merging
-    partition top-ks on host by (score desc, partition asc, doc asc) —
-    lifting Turbo's single-partition restriction (VERDICT r4 weak #5)."""
+    (scores, partition, ord) search_many contract as BlockMaxBM25.
+
+    With S > 1 partitions and a mesh, the ICI-sharded fast path runs:
+    every partition's sweep + row pick fuse into ONE device dispatch per
+    query chunk (parallel.turbo.ShardedTurbo) and the partition top-ks
+    merge ON DEVICE (parallel.spmd.merge_partition_topk) with the same
+    (score desc, partition asc, doc asc) tie-break as the host _merge3 —
+    bit-identical, because merging permutes the exact per-partition f32
+    scores without recomputing them. _merge3 remains the S == 1 /
+    mesh-less route and the reference the differential suite compares
+    against. The exact-rescore certificate path always runs per
+    partition on host, fused or not."""
 
     kind = "turbo"
 
-    def __init__(self, turbos: Sequence):
+    def __init__(self, turbos: Sequence, mesh=None):
         self.turbos = list(turbos)
+        self.mesh = mesh
+        self._sharded = None
+        self.merge_stats = {"merge_device": 0, "merge_host": 0,
+                            "partition_dispatches": 0,
+                            "fused_dispatches": 0}
+
+    def _count(self, key: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        self.merge_stats[key] += n
+        with _TURBO_NODE_LOCK:
+            _TURBO_NODE_STATS[key] += n
+
+    def _fused(self):
+        if self.mesh is None or len(self.turbos) < 2:
+            return None
+        if self._sharded is None:
+            from elasticsearch_tpu.parallel.turbo import ShardedTurbo
+
+            self._sharded = ShardedTurbo(self.turbos, self.mesh)
+        return self._sharded
 
     def search_many(self, batches: Sequence[List], k: int = 10, check=None):
-        per = [t.search_many(batches, k=k, check=check) for t in self.turbos]
-        results = []
-        for bi, batch in enumerate(batches):
-            Q = len(batch)
-            out_s = np.zeros((Q, k), np.float32)
-            out_p = np.zeros((Q, k), np.int32)
-            out_o = np.zeros((Q, k), np.int32)
-            if len(per) == 1:
-                s, d = per[0][bi]
-                out_s, out_o = s.copy(), d.copy()
-                out_o[out_s <= 0] = 0
-            else:
-                for qi in range(Q):
-                    cand = [(float(s), pi, int(d))
-                            for pi, res in enumerate(per)
-                            for s, d in zip(res[bi][0][qi], res[bi][1][qi])
-                            if s > 0]
-                    cand.sort(key=lambda x: (-x[0], x[1], x[2]))
-                    for j, (s, pi, d) in enumerate(cand[:k]):
-                        out_s[qi, j] = s
-                        out_p[qi, j] = pi
-                        out_o[qi, j] = d
-            results.append((out_s, out_p, out_o))
-        return results
+        fused = self._fused()
+        if fused is not None:
+            n0 = fused.fused_dispatches
+            per = fused.search_many(batches, k=k, check=check)
+            self._count("fused_dispatches", fused.fused_dispatches - n0)
+            self._count("partition_dispatches",
+                        (fused.fused_dispatches - n0) * len(self.turbos))
+        else:
+            per = [t.search_many(batches, k=k, check=check)
+                   for t in self.turbos]
+        return [self._merge_parts([p[bi] for p in per], len(batch), k,
+                                  device=fused is not None)
+                for bi, batch in enumerate(batches)]
+
+    def _merge_parts(self, per, Q: int, k: int, device: bool):
+        """Merge per-partition (scores, docs) into the engine-wide
+        (scores, partition, ord) contract — on device when the fused
+        path is active, through the host _merge3 reference otherwise."""
+        if len(per) > 1 and device and Q > 0:
+            from elasticsearch_tpu.parallel.spmd import merge_partition_topk
+
+            scores = np.stack([s for s, _ in per])
+            ords = np.stack([d for _, d in per])
+            out = merge_partition_topk(self.mesh, scores, ords, k)
+            self._count("merge_device")
+            return out
+        if len(per) > 1 and Q > 0:
+            self._count("merge_host")
+        return self._merge3(per, Q, k)
 
     def _merge3(self, per, Q: int, k: int):
         """Merge per-partition (scores, docs) into the engine-wide
@@ -357,17 +430,27 @@ class TurboEngine:
         """Batched bool top-k through the per-partition conjunctive
         sweeps — the BlockMax search_bool contract:
         (scores [Q,k], partition [Q,k], ord [Q,k])."""
-        per = [t.search_bool(queries, k=k, check=check)
-               for t in self.turbos]
-        return self._merge3(per, len(queries), k)
+        fused = self._fused()
+        if fused is not None:
+            n0 = fused.fused_dispatches
+            per = fused.search_bool(queries, k=k, check=check)
+            self._count("fused_dispatches", fused.fused_dispatches - n0)
+            self._count("partition_dispatches",
+                        (fused.fused_dispatches - n0) * len(self.turbos))
+        else:
+            per = [t.search_bool(queries, k=k, check=check)
+                   for t in self.turbos]
+        return self._merge_parts(per, len(queries), k,
+                                 device=fused is not None)
 
     def search_phrase(self, phrases: Sequence[List[str]], k: int = 10,
                       slop: int = 0, check=None):
         """Batched match_phrase top-k; slop-0 rides the adjacency
-        columns, other slops the exact host positional path."""
-        per = [t.search_phrase(phrases, k=k, slop=slop, check=check)
-               for t in self.turbos]
-        return self._merge3(per, len(phrases), k)
+        columns, other slops the exact host positional path. Sugar over
+        search_bool (exactly what each turbo's search_phrase is) so the
+        fused dispatch + device merge apply here too."""
+        specs = [{"phrases": [(list(p), int(slop), 1.0)]} for p in phrases]
+        return self.search_bool(specs, k=k, check=check)
 
     def hbm_bytes(self) -> int:
         total = 0
@@ -375,6 +458,8 @@ class TurboEngine:
             total += (t.cols_hi.nbytes + t.cols_lo.nbytes
                       + t.lane_docs.nbytes + t.lane_scores.nbytes
                       + t.live.nbytes)
+        if self._sharded is not None:
+            total += self._sharded.hbm_bytes()
         return total
 
     def prebuild_columns(self) -> int:
@@ -386,6 +471,7 @@ class TurboEngine:
         for t in self.turbos:
             for key, v in t.stats.items():
                 agg[key] = agg.get(key, 0) + v
+        agg.update(self.merge_stats)
         return agg
 
 
@@ -394,10 +480,11 @@ def turbo_eligible(segments, field: str, mesh, *,
                    cold_df: Optional[int] = None) -> bool:
     """True when TurboBM25 should serve this index's disjunctions: a real
     TPU backend (the Pallas kernels interpret on CPU — correct but not a
-    serving path), a single device (Turbo v1 is single-chip; multi-chip
-    serves through transport scatter-gather or the SPMD BlockMax path),
-    and the FULL colizable column set resident within the HBM budget (no
-    cache churn). ES_TPU_FORCE_TURBO=1 overrides the backend gate for
+    serving path) and the FULL colizable column set resident within the
+    HBM budget (no cache churn). Multi-device meshes are served too (the
+    PR 4 fused path shards partitions over ICI and merges on device);
+    the `mesh` parameter is kept for signature stability but no longer
+    gates. ES_TPU_FORCE_TURBO=1 overrides the backend gate for
     differential tests."""
     import jax
 
@@ -406,8 +493,6 @@ def turbo_eligible(segments, field: str, mesh, *,
 
     force = os.environ.get("ES_TPU_FORCE_TURBO") == "1"
     if not force and jax.default_backend() != "tpu":
-        return False
-    if mesh is not None and mesh.devices.size > 1:
         return False
     if cold_df is None:
         cold_df = _env_cold_df()
@@ -480,7 +565,10 @@ def select_bm25_engine(segments, field: str, live_masks, mesh, *,
                 stacked, hbm_budget_bytes=need_bytes,
                 total_docs=total_docs, avgdl=avgdl,
                 df_of=lambda t: df_map.get(t, 0), **kwargs))
-        return TurboEngine(turbos)
+        # the fused S > 1 path builds its OWN dp=1 partition mesh over the
+        # visible devices — the caller's mesh keeps its (dp, shard) layout
+        # for the BlockMax/SPMD programs and is not reused here
+        return TurboEngine(turbos, mesh=_turbo_mesh(len(turbos)))
     stacked = build_stacked_bm25(segments, field, live_masks=live_masks,
                                  mesh=mesh, serve_only=True)
     return BlockMaxBM25(stacked, mesh)

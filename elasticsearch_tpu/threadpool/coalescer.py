@@ -27,6 +27,7 @@ The flush window comes from `ES_TPU_COALESCE_US` (microseconds, default
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 from typing import Dict, List, Optional, Tuple
@@ -37,6 +38,25 @@ DEFAULT_WINDOW_US = 2000.0
 SMALL_BATCH_MAX = 8
 # flush early once a held batch reaches this many queries
 MAX_BATCH = 64
+
+
+# monotonic engine serials for batch keying: id(engine) could be REUSED
+# by a new engine allocated after an old one is garbage-collected
+# mid-window (a snapshot refresh drops the old TurboEngine/ShardedTurbo
+# wrapper), silently merging waiters across snapshots; a serial pinned on
+# the object can never collide
+_engine_serials = itertools.count(1)
+
+
+def _engine_key(engine) -> int:
+    s = getattr(engine, "_coalesce_serial", None)
+    if s is None:
+        s = next(_engine_serials)
+        try:
+            engine._coalesce_serial = s
+        except AttributeError:     # __slots__ engines: degrade to id()
+            return id(engine)
+    return s
 
 
 def _env_window_us() -> float:
@@ -105,8 +125,9 @@ class DispatchCoalescer:
                 self._direct_dispatches += 1
             return engine.search_many([list(queries)], k=k, check=check)[0]
 
-        key = (id(engine), int(k))
         with self._lock:
+            # key under the lock so one engine gets exactly one serial
+            key = (_engine_key(engine), int(k))
             batch = self._pending.get(key)
             leader = batch is None
             if leader:
