@@ -823,5 +823,77 @@ def main():
     emit(partial=False)
 
 
+def dryrun_faults() -> int:
+    """Containment dry-run (PR 5): inject a deterministic partition fault
+    into a tiny 2-partition fused engine and assert the request STILL
+    completes with results bit-identical to the no-fault host reference,
+    with nonzero tpu_health counters. One JSON line on stdout; exit 0/1."""
+    os.environ.setdefault("ES_TPU_FORCE_TURBO", "1")
+    if os.environ.get("TEST_ON_TPU") != "1":
+        # validation mode, not perf: the virtual 8-device CPU mesh (same
+        # as tests/conftest.py) keeps the fused S=2 path exercisable off
+        # the contended chip
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.common import faults
+    from elasticsearch_tpu.common.health import node_health_stats
+    from elasticsearch_tpu.index.segment import build_field_postings
+    from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+    from elasticsearch_tpu.parallel.turbo import TurboBM25
+    from elasticsearch_tpu.search.serving import TurboEngine, _turbo_mesh
+
+    def part(n_docs, vocab, seed):
+        rng = np.random.default_rng(seed)
+        probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+        probs /= probs.sum()
+        lens = rng.integers(4, 24, size=n_docs).astype(np.int64)
+        tokens = rng.choice(vocab, size=int(lens.sum()),
+                            p=probs).astype(np.int64)
+        tok_docs = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+        fp = build_field_postings(
+            "body", lens, tok_docs, tokens,
+            [f"t{i}" for i in range(vocab)])
+        stacked = build_stacked_bm25([_Seg(n_docs, fp)], "body",
+                                     serve_only=True)
+        return TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=5)
+
+    log("dryrun_faults: building 2-partition fused engine...")
+    eng = TurboEngine([part(900, 40, 1), part(1300, 32, 2)],
+                      mesh=_turbo_mesh(2))
+    batch = [["t1", "t3"], ["t2", "t5"], ["t0", "t7"], ["t4", "t1"]]
+    k = 10
+    want = eng._merge3([t.search_many_host([batch], k=k)[0]
+                        for t in eng.turbos], len(batch), k)
+    with faults.inject("column_upload#1:raise@1"):
+        got = eng.search_many([batch], k=k)[0]
+    identical = all(np.array_equal(np.asarray(g), np.asarray(w))
+                    for g, w in zip(got, want))
+    st = eng.stats
+    node = node_health_stats()
+    ok = (identical and st.get("health_device_faults", 0) >= 1
+          and node.get("device_faults", 0) >= 1)
+    print(json.dumps({
+        "metric": "dryrun_faults",
+        "ok": bool(ok),
+        "identical_under_fault": bool(identical),
+        "health_device_faults": int(st.get("health_device_faults", 0)),
+        "health_fallback_queries": int(
+            st.get("health_fallback_queries", 0)),
+        "node_device_faults": int(node.get("device_faults", 0)),
+    }), flush=True)
+    log(f"dryrun_faults: identical={identical} "
+        f"device_faults={st.get('health_device_faults', 0)}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
+    if "dryrun_faults" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_faults":
+        sys.exit(dryrun_faults())
     main()

@@ -57,6 +57,36 @@ class CircuitBreakingError(ElasticsearchTpuError):
     error_type = "circuit_breaking_exception"
 
 
+class DeviceFaultError(ElasticsearchTpuError):
+    """A device dispatch failed (injected or organic XLA runtime error).
+
+    Carries the dispatch `site` and optional `part` (partition id) so the
+    containment layer can attribute the failure to a shard."""
+
+    status = 503
+    error_type = "tpu_device_fault_exception"
+
+    def __init__(self, message: str, site: str = None, part: int = None,
+                 **metadata):
+        super().__init__(message, **metadata)
+        self.site = site
+        self.part = part
+
+    def to_dict(self) -> dict:
+        out = super().to_dict()
+        if self.site is not None:
+            out["site"] = self.site
+        if self.part is not None:
+            out["partition"] = self.part
+        return out
+
+
+class HbmOomError(DeviceFaultError):
+    """Device memory exhausted mid-dispatch (RESOURCE_EXHAUSTED)."""
+
+    error_type = "tpu_hbm_oom_exception"
+
+
 class IndexClosedError(ElasticsearchTpuError):
     status = 400
     error_type = "index_closed_exception"

@@ -1,0 +1,277 @@
+"""Deterministic, seedable device-fault injection for the TPU serving path.
+
+Any named dispatch site can be made to raise a device fault, return an
+HBM-OOM, or hang past a deadline on the Nth call — driven either by the
+``ES_TPU_FAULTS`` environment spec (parsed once at import) or by the
+programmatic API (`install` / `clear` / the `inject` context manager, which
+tears down cleanly enough to run inside the interpret-mode differential
+suites).
+
+Spec grammar (';'-separated clauses)::
+
+    site[#part]:mode[@nth][xcount][=arg][~prob]
+
+      site   one of KNOWN_SITES (turbo_sweep, fused_dispatch, merge_kernel,
+             column_upload, blockmax_pass)
+      #part  restrict to one partition id (default: any)
+      mode   raise | oom | hang
+      @nth   1-based call number at which the fault first fires (default 1)
+      xcount how many consecutive calls fire ('inf' = forever; default 1)
+      =arg   hang sleep seconds (default 0.05); ignored for raise/oom
+      ~prob  fire with probability prob per eligible call, seeded from
+             ES_TPU_FAULTS_SEED ^ hash(site) so runs are reproducible
+
+Example: ``ES_TPU_FAULTS='fused_dispatch:raise@2;column_upload#1:oom@1x2'``
+
+`device_errors` is the companion: it wraps REAL runtime errors coming out of
+a device dispatch (XlaRuntimeError and friends) into `DeviceFaultError` so
+the containment layer upstream sees one exception type for injected and
+organic faults alike.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.common.errors import DeviceFaultError, HbmOomError
+
+KNOWN_SITES = frozenset({
+    "turbo_sweep",       # TurboBM25 device sweep (disjunctive + bool)
+    "fused_dispatch",    # ShardedTurbo fused S>1 shard_map dispatch
+    "merge_kernel",      # device-side partition top-k merge
+    "column_upload",     # int8 column build/refresh onto the device
+    "blockmax_pass",     # BlockMax engine device pass
+})
+
+_MODES = frozenset({"raise", "oom", "hang"})
+
+# Real device-runtime error type names (matched by name so we never import
+# jaxlib internals) plus status strings seen in stringified XLA errors.
+_DEVICE_ERROR_NAMES = frozenset({
+    "XlaRuntimeError", "JaxRuntimeError", "RuntimeError",
+    "InternalError", "ResourceExhaustedError",
+})
+_DEVICE_ERROR_MARKERS = ("RESOURCE_EXHAUSTED", "INTERNAL", "out of memory",
+                         "DEADLINE_EXCEEDED")
+
+
+class FaultSpecError(ValueError):
+    """Malformed ES_TPU_FAULTS clause."""
+
+
+@dataclass
+class _Clause:
+    site: str
+    part: Optional[int]
+    mode: str
+    nth: int = 1
+    count: float = 1          # float so 'inf' works
+    arg: float = 0.05
+    prob: Optional[float] = None
+    calls: int = 0            # eligible calls seen so far
+    fired: int = 0
+    rng: Optional[random.Random] = None
+
+    def matches(self, site: str, part: Optional[int]) -> bool:
+        if site != self.site:
+            return False
+        if self.part is not None and part != self.part:
+            return False
+        return True
+
+    def should_fire(self) -> bool:
+        self.calls += 1
+        if self.prob is not None:
+            if self.rng.random() >= self.prob:
+                return False
+        elif self.calls < self.nth:
+            return False
+        if self.fired >= self.count:
+            return False
+        self.fired += 1
+        return True
+
+
+@dataclass
+class FaultRecord:
+    """One contained device fault, as reported in `_shards` failures."""
+    site: str
+    partition: Optional[int]
+    error: BaseException
+    recovered: bool = True
+
+    @classmethod
+    def from_error(cls, e: BaseException, partition: Optional[int] = None,
+                   recovered: bool = True) -> "FaultRecord":
+        return cls(site=getattr(e, "site", None) or "device",
+                   partition=(partition if partition is not None
+                              else getattr(e, "part", None)),
+                   error=e, recovered=recovered)
+
+
+def parse_spec(spec: str) -> List[_Clause]:
+    seed = int(os.environ.get("ES_TPU_FAULTS_SEED", "0") or 0)
+    clauses: List[_Clause] = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        if ":" not in raw:
+            raise FaultSpecError(f"fault clause missing ':': {raw!r}")
+        head, tail = raw.split(":", 1)
+        part: Optional[int] = None
+        if "#" in head:
+            head, p = head.split("#", 1)
+            try:
+                part = int(p)
+            except ValueError:
+                raise FaultSpecError(f"bad partition in clause {raw!r}")
+        site = head.strip()
+        if site not in KNOWN_SITES:
+            raise FaultSpecError(
+                f"unknown fault site {site!r}; known: {sorted(KNOWN_SITES)}")
+        c = _Clause(site=site, part=part, mode="")
+        # peel ~prob, =arg, xcount, @nth off the tail (order-independent
+        # parse: split on each marker from the right)
+        for marker, conv, attr in (("~", float, "prob"), ("=", float, "arg"),
+                                   ("x", None, "count"), ("@", int, "nth")):
+            if marker in tail:
+                tail, v = tail.rsplit(marker, 1)
+                try:
+                    if attr == "count":
+                        c.count = float("inf") if v == "inf" else int(v)
+                    else:
+                        setattr(c, attr, conv(v))
+                except ValueError:
+                    raise FaultSpecError(f"bad {attr!r} in clause {raw!r}")
+        c.mode = tail.strip()
+        if c.mode not in _MODES:
+            raise FaultSpecError(
+                f"unknown fault mode {c.mode!r}; known: {sorted(_MODES)}")
+        if c.prob is not None:
+            c.rng = random.Random(seed ^ (hash(site) & 0xFFFFFFFF))
+        clauses.append(c)
+    return clauses
+
+
+_LOCK = threading.Lock()
+_ACTIVE: Optional[List[_Clause]] = None
+
+
+def install(spec: str) -> None:
+    """Install a fault spec process-wide (replaces any previous spec)."""
+    global _ACTIVE
+    clauses = parse_spec(spec)
+    with _LOCK:
+        _ACTIVE = clauses or None
+
+
+def clear() -> None:
+    global _ACTIVE
+    with _LOCK:
+        _ACTIVE = None
+
+
+@contextlib.contextmanager
+def inject(spec: str):
+    """Scoped installation: install `spec`, restore the prior state on exit
+    (exception-safe, so differential suites can nest it freely)."""
+    global _ACTIVE
+    clauses = parse_spec(spec)
+    with _LOCK:
+        prev, _ACTIVE = _ACTIVE, clauses
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _ACTIVE = prev
+
+
+def fault_point(site: str, part: Optional[int] = None) -> None:
+    """Named dispatch site: raises/oom/hangs when an active clause fires.
+
+    The module-level `_ACTIVE is None` check keeps the no-faults fast path
+    to a single attribute load."""
+    active = _ACTIVE
+    if active is None:
+        return
+    with _LOCK:
+        if _ACTIVE is not active:     # swapped under us; re-read
+            active = _ACTIVE
+            if active is None:
+                return
+        for c in active:
+            if not c.matches(site, part):
+                continue
+            if not c.should_fire():
+                continue
+            mode, arg = c.mode, c.arg
+            break
+        else:
+            return
+    if mode == "hang":
+        # Sleep past the deadline, then return normally: the dispatch
+        # "completes" late and the Deadline check upstream times it out.
+        time.sleep(arg)
+        return
+    if mode == "oom":
+        raise HbmOomError(
+            f"injected HBM OOM at {site}"
+            + (f"#{part}" if part is not None else ""),
+            site=site, part=part)
+    raise DeviceFaultError(
+        f"injected device fault at {site}"
+        + (f"#{part}" if part is not None else ""),
+        site=site, part=part)
+
+
+def is_device_error(e: BaseException) -> bool:
+    if isinstance(e, DeviceFaultError):
+        return True
+    name = type(e).__name__
+    if name in _DEVICE_ERROR_NAMES:
+        if name == "RuntimeError":
+            s = str(e)
+            return any(m in s for m in _DEVICE_ERROR_MARKERS)
+        return True
+    return False
+
+
+@contextlib.contextmanager
+def device_errors(site: str, part: Optional[int] = None):
+    """Translate organic device-runtime errors at this site into
+    `DeviceFaultError` (HBM OOMs into `HbmOomError`) so the containment
+    layer sees one exception type; everything else passes through."""
+    try:
+        yield
+    except DeviceFaultError:
+        raise
+    except Exception as e:
+        if not is_device_error(e):
+            raise
+        msg = f"device fault at {site}" + (
+            f"#{part}" if part is not None else "") + f": {e}"
+        if "RESOURCE_EXHAUSTED" in str(e) or "out of memory" in str(e):
+            raise HbmOomError(msg, site=site, part=part) from e
+        raise DeviceFaultError(msg, site=site, part=part) from e
+
+
+@contextlib.contextmanager
+def device_dispatch(site: str, part: Optional[int] = None):
+    """fault_point + device_errors: the standard wrapper for a dispatch."""
+    fault_point(site, part)
+    with device_errors(site, part):
+        yield
+
+
+# Environment-driven installation (parse errors fail LOUD at import — a
+# typo'd fault spec silently doing nothing would invalidate a chaos run).
+_env_spec = os.environ.get("ES_TPU_FAULTS")
+if _env_spec:
+    install(_env_spec)

@@ -188,6 +188,11 @@ class IndexService:
             resp = self.serving.try_search(request, search_type, task=task)
         else:
             resp = None
+        if resp is not None and not isinstance(resp, dict):
+            # request-level failure from the fast path (e.g.
+            # allow_partial_search_results=false with a faulted shard):
+            # the error, not a dense retry, is the answer
+            raise resp
         if resp is None:
             resp = self._search_dense(request, search_type,
                                       searchers=searchers, task=task)

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.common.health import EngineHealth
 from elasticsearch_tpu.parallel.compat import SHARD_MAP_RETRACE_SAFE, shard_map
 from elasticsearch_tpu.ops import bm25_idf, next_bucket
 from elasticsearch_tpu.parallel.spmd import (
@@ -114,6 +116,10 @@ class BlockMaxBM25:
         while self._qc_dense_cap * 2 <= min(cap, 512):
             self._qc_dense_cap *= 2
         self._terms: Dict[str, _TermMeta] = {}
+        # circuit state lives here, enforced by the serving layer (this
+        # engine has no internal host tier — the dense executor is its
+        # fallback)
+        self.health = EngineHealth("blockmax")
         self._build_hot_columns()
 
     # ---------------- build ----------------
@@ -311,7 +317,7 @@ class BlockMaxBM25:
         return self.search_many([queries], k)[0]
 
     def search_many(self, batches: Sequence[List], k: int = 10,
-                    check=None):
+                    check=None, fault_log=None):
         """Pipeline many query batches through the two-pass executor with
         exactly TWO host<->device round trips total: all pass-A programs
         dispatch, thetas come back in one stacked transfer, all pass-B
@@ -326,6 +332,8 @@ class BlockMaxBM25:
         Returns per batch: (scores [Q,k], shard [Q,k], ord [Q,k]).
         Wall-clock per phase lands in self.last_timing (seconds)."""
         import time as _time
+
+        faults.fault_point("blockmax_pass")
 
         timing = {"assemble_a": 0.0, "theta_fetch": 0.0, "select": 0.0,
                   "assemble_dispatch_b": 0.0, "result_fetch": 0.0,
@@ -548,7 +556,7 @@ class BlockMaxBM25:
         return np.asarray(packed)[0]
 
     def search_bool(self, queries: Sequence[dict], k: int = 10,
-                    check=None):
+                    check=None, fault_log=None):
         """Batched exact `bool` top-k on device (BASELINE config 2 — the
         reference's WAND/conjunction path, ref: Lucene BooleanWeight +
         MinShouldMatchSumScorer driven through BlockMaxConjunctionScorer).
@@ -571,6 +579,7 @@ class BlockMaxBM25:
         block to the device by orders of magnitude; heavy conjunctions
         (stopword-grade musts) go to the device program where the dense
         matmul amortizes."""
+        faults.fault_point("blockmax_pass")
         Q = len(queries)
         out = np.zeros((Q, 3, k), np.float32)
         specs = []

@@ -48,6 +48,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
+from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.common.errors import DeviceFaultError
+from elasticsearch_tpu.common.faults import FaultRecord
 from elasticsearch_tpu.index.positions import phrase_freqs
 from elasticsearch_tpu.index.segment import tf_at
 from elasticsearch_tpu.ops import bm25_idf
@@ -278,6 +281,9 @@ class TurboBM25:
         # whose lane arrays are long gone
         self._tile_bases: Dict[str, np.ndarray] = {}
         self.force_cert_fail = False   # test hook: exercise the fallback
+        # partition id for fault-site attribution (set by TurboEngine /
+        # ShardedTurbo when this engine serves one partition of many)
+        self.part_id = 0
         # bumped whenever cols_hi/cols_lo are rebuilt, so the fused
         # multi-partition cache (ShardedTurbo._refresh) re-syncs only the
         # partitions whose columns actually changed
@@ -346,8 +352,28 @@ class TurboBM25:
             # with the column, recompute if the phrase is colized again
             self._phrases.pop(key, None)
 
+    def _reset_columns(self) -> None:
+        """Drop the whole column cache. After a failed build dispatch the
+        device-side slot contents are unknown — a partially-built column
+        would score wrong silently — so the cache restarts empty and
+        rebuilds lazily on the next query."""
+        dp_chunks = self.dp_rows // 16
+        self.cols_hi = jnp.zeros((dp_chunks, self.Hp + 1, 16, 128),
+                                 jnp.int8)
+        self.cols_lo = jnp.zeros((dp_chunks, self.Hp + 1, 16, 128),
+                                 jnp.int8)
+        self._slot_of.clear()
+        self._lru.clear()
+        self._free = list(range(self.Hp))
+        self._pending_zero = []
+        self._tile_bases.clear()
+        self.cols_epoch += 1
+
     def ensure_columns(self, terms: Sequence[str],
                        protect_extra: Sequence[str] = ()) -> None:
+        # injected faults fire BEFORE any slot-pool mutation so containment
+        # never observes a half-mutated cache
+        faults.fault_point("column_upload", self.part_id)
         self._tick += 1
         need: List[_TermInfo] = []
         for t in dict.fromkeys(terms):
@@ -396,20 +422,29 @@ class TurboBM25:
         bases = np.concatenate(base_l)
         slots = np.concatenate(slot_l)
         t0 = time.monotonic()
-        # split giant (cold-start) builds into bounded dispatches
-        for off in range(0, len(rows), _BUILD_BUCKETS[-1]):
-            part = slice(off, off + _BUILD_BUCKETS[-1])
-            r_p, n_p, b_p, s_p = rows[part], nrows[part], bases[part], slots[part]
-            ng = _bucket(len(r_p))
-            pad = ng - len(r_p)
-            self.cols_hi, self.cols_lo = build_columns(
-                jnp.asarray(np.concatenate([r_p, np.zeros(pad, np.int32)])),
-                jnp.asarray(np.concatenate([n_p, np.zeros(pad, np.int32)])),
-                jnp.asarray(np.concatenate([b_p, np.zeros(pad, np.int32)])),
-                jnp.asarray(np.concatenate(
-                    [s_p, np.full(pad, self.Hp, np.int32)])),
-                self.lane_docs, self.lane_scores,
-                self.cols_hi, self.cols_lo, n_groups=ng)
+        try:
+            with faults.device_errors("column_upload", self.part_id):
+                # split giant (cold-start) builds into bounded dispatches
+                for off in range(0, len(rows), _BUILD_BUCKETS[-1]):
+                    part = slice(off, off + _BUILD_BUCKETS[-1])
+                    r_p, n_p, b_p, s_p = (rows[part], nrows[part],
+                                          bases[part], slots[part])
+                    ng = _bucket(len(r_p))
+                    pad = ng - len(r_p)
+                    self.cols_hi, self.cols_lo = build_columns(
+                        jnp.asarray(np.concatenate(
+                            [r_p, np.zeros(pad, np.int32)])),
+                        jnp.asarray(np.concatenate(
+                            [n_p, np.zeros(pad, np.int32)])),
+                        jnp.asarray(np.concatenate(
+                            [b_p, np.zeros(pad, np.int32)])),
+                        jnp.asarray(np.concatenate(
+                            [s_p, np.full(pad, self.Hp, np.int32)])),
+                        self.lane_docs, self.lane_scores,
+                        self.cols_hi, self.cols_lo, n_groups=ng)
+        except DeviceFaultError:
+            self._reset_columns()
+            raise
         self.cols_epoch += 1
         self.stats["builds"] += len(need)
         self.stats["build_s"] += time.monotonic() - t0
@@ -459,6 +494,7 @@ class TurboBM25:
         pairs into synthetic 128-wide lane arrays and run them through the
         SAME build_columns outer-product kernel and LRU slot pool as term
         columns. Eviction/zeroing discipline is shared (_evict)."""
+        faults.fault_point("column_upload", self.part_id)
         self._tick += 1
         need: List[_PhraseInfo] = []
         for terms in dict.fromkeys(tuple(p) for p in phrase_lists):
@@ -536,20 +572,28 @@ class TurboBM25:
         bases = np.concatenate(base_l)
         slots = np.concatenate(slot_l)
         t0 = time.monotonic()
-        for off in range(0, len(rows), _BUILD_BUCKETS[-1]):
-            part = slice(off, off + _BUILD_BUCKETS[-1])
-            r_p, n_p, b_p, s_p = (rows[part], nrows[part],
-                                  bases[part], slots[part])
-            ng = _bucket(len(r_p))
-            pad = ng - len(r_p)
-            self.cols_hi, self.cols_lo = build_columns(
-                jnp.asarray(np.concatenate([r_p, np.zeros(pad, np.int32)])),
-                jnp.asarray(np.concatenate([n_p, np.zeros(pad, np.int32)])),
-                jnp.asarray(np.concatenate([b_p, np.zeros(pad, np.int32)])),
-                jnp.asarray(np.concatenate(
-                    [s_p, np.full(pad, self.Hp, np.int32)])),
-                lane_docs, lane_scores,
-                self.cols_hi, self.cols_lo, n_groups=ng)
+        try:
+            with faults.device_errors("column_upload", self.part_id):
+                for off in range(0, len(rows), _BUILD_BUCKETS[-1]):
+                    part = slice(off, off + _BUILD_BUCKETS[-1])
+                    r_p, n_p, b_p, s_p = (rows[part], nrows[part],
+                                          bases[part], slots[part])
+                    ng = _bucket(len(r_p))
+                    pad = ng - len(r_p)
+                    self.cols_hi, self.cols_lo = build_columns(
+                        jnp.asarray(np.concatenate(
+                            [r_p, np.zeros(pad, np.int32)])),
+                        jnp.asarray(np.concatenate(
+                            [n_p, np.zeros(pad, np.int32)])),
+                        jnp.asarray(np.concatenate(
+                            [b_p, np.zeros(pad, np.int32)])),
+                        jnp.asarray(np.concatenate(
+                            [s_p, np.full(pad, self.Hp, np.int32)])),
+                        lane_docs, lane_scores,
+                        self.cols_hi, self.cols_lo, n_groups=ng)
+        except DeviceFaultError:
+            self._reset_columns()
+            raise
         self.cols_epoch += 1
         self.stats["builds"] += len(need)
         self.stats["phrase_builds"] += len(need)
@@ -691,7 +735,8 @@ class TurboBM25:
         for off, n, packed_dev in pending:
             if check is not None:
                 check()
-            packed = np.asarray(packed_dev)        # [QC, n_rows + 1]
+            with faults.device_errors("turbo_sweep", self.part_id):
+                packed = np.asarray(packed_dev)    # [QC, n_rows + 1]
             rows_all = packed[:, :n_rows].astype(np.int64)
             bounds = packed[:, n_rows]
             for qi in range(n):
@@ -746,8 +791,10 @@ class TurboBM25:
 
     def _sweep(self, chunk, QC):
         wq, qscale = self._sweep_weights(chunk, QC)
-        out = sweep_rowmax(jnp.asarray(qscale), self.cols_hi, self.cols_lo,
-                           jnp.asarray(wq), self.live, QC=QC, nsw=self.nsw)
+        with faults.device_dispatch("turbo_sweep", self.part_id):
+            out = sweep_rowmax(jnp.asarray(qscale), self.cols_hi,
+                               self.cols_lo, jnp.asarray(wq), self.live,
+                               QC=QC, nsw=self.nsw)
         return wq, qscale, out
 
     def _finish_query(self, terms, cand_docs, bound, k):
@@ -1064,10 +1111,11 @@ class TurboBM25:
 
     def _sweep_bool(self, chunk: Sequence[_BoolQuery], QC: int):
         wq, wp, nreq, qscale = self._bool_weights(chunk, QC)
-        return sweep_rowmax_conj(
-            jnp.asarray(qscale), jnp.asarray(nreq), self.cols_hi,
-            self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
-            QC=QC, nsw=self.nsw)
+        with faults.device_dispatch("turbo_sweep", self.part_id):
+            return sweep_rowmax_conj(
+                jnp.asarray(qscale), jnp.asarray(nreq), self.cols_hi,
+                self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
+                QC=QC, nsw=self.nsw)
 
     def _phrase_pf(self, terms, slop, pinfo, docs: np.ndarray):
         """(pf f32[n], present bool[n]) of a phrase at candidate docs."""
@@ -1287,7 +1335,8 @@ class TurboBM25:
         for sel, packed_dev in pending:
             if check is not None:
                 check()
-            packed = np.asarray(packed_dev)
+            with faults.device_errors("turbo_sweep", self.part_id):
+                packed = np.asarray(packed_dev)
             rows_all = packed[:, :n_rows].astype(np.int64)
             bounds = packed[:, n_rows]
             for j, qi in enumerate(sel):
@@ -1310,6 +1359,57 @@ class TurboBM25:
         over search_bool; slop-0 phrases ride the adjacency columns."""
         specs = [{"phrases": [(list(p), slop, 1.0)]} for p in phrases]
         return self.search_bool(specs, k=k, check=check)
+
+    # ---------------- host fallback tier (zero device dispatches) ----------
+
+    def _exact_query(self, terms, k: int):
+        """Exact host top-k for one flat [(term, boost)] query — the
+        containment fallback when this partition's device path faulted.
+        Bit-identical to the certificate-passing device route (both end in
+        _exact_scores over the same candidate set ordering)."""
+        qterms = []
+        for t, b in terms:
+            info = self._term(t)
+            if info is not None:
+                qterms.append((t, b, info))
+        if not qterms:
+            return np.empty(0, np.float32), np.empty(0, np.int32)
+        return self._exact_merge(qterms, k)
+
+    def search_many_host(self, batches: Sequence[List], k: int = 10,
+                         check=None):
+        """search_many semantics served entirely on host — the
+        circuit-open fallback tier (BM25S-style exact merge; no device
+        dispatch, no column cache mutation)."""
+        flat, spans = _flatten_queries(batches)
+        out_s = np.zeros((len(flat), k), np.float32)
+        out_d = np.zeros((len(flat), k), np.int32)
+        for qi, terms in enumerate(flat):
+            if check is not None:
+                check()
+            s, d = self._exact_query(terms, k)
+            out_s[qi, : len(s)] = s
+            out_d[qi, : len(d)] = d
+        return [(out_s[o: o + n], out_d[o: o + n]) for o, n in spans]
+
+    def search_bool_host(self, queries: Sequence[dict], k: int = 10,
+                         check=None):
+        """search_bool semantics served entirely on host (the
+        _bool_host_exact route every device bool result is already
+        bit-identical to)."""
+        Q = len(queries)
+        out_s = np.zeros((Q, k), np.float32)
+        out_d = np.zeros((Q, k), np.int32)
+        for qi, spec in enumerate(queries):
+            if check is not None:
+                check()
+            r = self._resolve_bool(spec)
+            if r is None:
+                continue
+            s, d = self._bool_host_exact(r, k)
+            out_s[qi, : len(s)] = s
+            out_d[qi, : len(d)] = d
+        return out_s, out_d
 
 
 # --------------------------------------------------------------------------
@@ -1410,18 +1510,23 @@ class ShardedTurbo:
         self._epochs = [-1] * S
         self.fused_dispatches = 0
 
-    def _refresh(self) -> None:
-        """Re-sync fused column slices for partitions whose caches were
+    def _refresh_part(self, i: int) -> None:
+        """Re-sync one partition's fused column slice if its cache was
         rebuilt since the last dispatch (cols_epoch discipline)."""
-        for i, t in enumerate(self.turbos):
-            if self._epochs[i] == t.cols_epoch:
-                continue
+        t = self.turbos[i]
+        if self._epochs[i] == t.cols_epoch:
+            return
+        with faults.device_dispatch("column_upload", part=i):
             a, b = t.cols_hi.shape[0], t.cols_hi.shape[1]
             self.cols_hi = jax.device_put(
                 self.cols_hi.at[i, :a, :b].set(t.cols_hi), self._sharding)
             self.cols_lo = jax.device_put(
                 self.cols_lo.at[i, :a, :b].set(t.cols_lo), self._sharding)
-            self._epochs[i] = t.cols_epoch
+        self._epochs[i] = t.cols_epoch
+
+    def _refresh(self) -> None:
+        for i in range(len(self.turbos)):
+            self._refresh_part(i)
 
     def hbm_bytes(self) -> int:
         return (self.cols_hi.nbytes + self.cols_lo.nbytes
@@ -1436,10 +1541,16 @@ class ShardedTurbo:
             w, q = t._sweep_weights(chunk, QC)
             wq[i, :, :, : w.shape[2]] = w
             qs[i] = q
+        # the counter moves AFTER the launch so a faulted dispatch is not
+        # counted — the circuit tests pin "zero device dispatches" while
+        # open by watching it
+        with faults.device_dispatch("fused_dispatch"):
+            out = _fused_sweep_disj(
+                jnp.asarray(qs), self.cols_hi, self.cols_lo,
+                jnp.asarray(wq), self.live, mesh=self.mesh, QC=QC,
+                nsw=self.nsw, n_rows=n_rows)
         self.fused_dispatches += 1
-        return _fused_sweep_disj(
-            jnp.asarray(qs), self.cols_hi, self.cols_lo, jnp.asarray(wq),
-            self.live, mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
+        return out
 
     def _dispatch_bool(self, resolved, dev_sets, sel, QC: int,
                        n_rows: int):
@@ -1456,32 +1567,47 @@ class ShardedTurbo:
             wp[i, :, :hp] = p
             nreq[i] = nr
             qs[i] = q
+        with faults.device_dispatch("fused_dispatch"):
+            out = _fused_sweep_bool(
+                jnp.asarray(qs), jnp.asarray(nreq), self.cols_hi,
+                self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
+                mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
         self.fused_dispatches += 1
-        return _fused_sweep_bool(
-            jnp.asarray(qs), jnp.asarray(nreq), self.cols_hi,
-            self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
-            mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
+        return out
 
     # ---------------- search ----------------
 
     def search_many(self, batches: Sequence[List], k: int = 10,
-                    check=None):
+                    check=None, fault_log=None):
         """per[si][bi] = (scores [Q, k] f32, ords [Q, k] i32) — the same
         values `self.turbos[si].search_many(batches)` returns solo, but
-        every partition's sweep rides one fused dispatch per chunk."""
+        every partition's sweep rides one fused dispatch per chunk.
+
+        Device-fault containment: a partition whose column ensure/upload
+        faults, or any query chunk whose fused dispatch faults, is scored
+        on host via the exact-merge path (bit-identical) — the batch
+        still completes. Contained faults append `FaultRecord`s to
+        fault_log (when given) so the serving layer can report
+        failed-then-recovered shards."""
         flat, spans = _flatten_queries(batches)
         S = len(self.turbos)
         if not flat:
             return [[(np.zeros((n, k), np.float32),
                       np.zeros((n, k), np.int32)) for _, n in spans]
                     for _ in range(S)]
-        for t in self.turbos:
-            t.ensure_columns(
-                [tm for q in flat for tm, _ in q
-                 if (i := t._term(tm)) is not None and i.df >= t.cold_df])
-        self._refresh()
+        failed: Dict[int, DeviceFaultError] = {}
+        for i, t in enumerate(self.turbos):
+            try:
+                t.ensure_columns(
+                    [tm for q in flat for tm, _ in q
+                     if (inf := t._term(tm)) is not None
+                     and inf.df >= t.cold_df])
+                self._refresh_part(i)
+            except DeviceFaultError as e:
+                failed[i] = e
         n_rows = max(_GLOBAL_ROWS, k + 5)
         pending = []
+        fused_err: Optional[DeviceFaultError] = None
         off = 0
         while off < len(flat):
             rem = len(flat) - off
@@ -1490,51 +1616,82 @@ class ShardedTurbo:
             chunk = flat[off: off + take]
             if check is not None:
                 check()
-            pending.append((off, len(chunk),
-                            self._dispatch_disj(chunk, take, n_rows)))
+            try:
+                packed_dev = self._dispatch_disj(chunk, take, n_rows)
+            except DeviceFaultError as e:
+                packed_dev, fused_err = None, e
+            pending.append((off, len(chunk), packed_dev))
             off += len(chunk)
         out_s = np.zeros((S, len(flat), k), np.float32)
         out_d = np.zeros((S, len(flat), k), np.int32)
         for off, n, packed_dev in pending:
             if check is not None:
                 check()
-            packed = np.asarray(packed_dev)    # [Sp, QC, n_rows + 1]
+            packed = None
+            if packed_dev is not None:
+                try:
+                    with faults.device_errors("fused_dispatch"):
+                        packed = np.asarray(packed_dev)
+                except DeviceFaultError as e:     # async fault at fetch
+                    packed, fused_err = None, e
             for si, t in enumerate(self.turbos):
-                rows_all = packed[si, :, :n_rows].astype(np.int64)
-                bounds = packed[si, :, n_rows]
+                host_only = si in failed or packed is None
+                if not host_only:
+                    rows_all = packed[si, :, :n_rows].astype(np.int64)
+                    bounds = packed[si, :, n_rows]
                 for qi in range(n):
-                    docs = t._collect_docs(rows_all[qi])
-                    s, d = t._finish_query(flat[off + qi], docs,
-                                           float(bounds[qi]), k)
+                    if host_only:
+                        s, d = t._exact_query(flat[off + qi], k)
+                    else:
+                        docs = t._collect_docs(rows_all[qi])
+                        s, d = t._finish_query(flat[off + qi], docs,
+                                               float(bounds[qi]), k)
                     out_s[si, off + qi, : len(s)] = s
                     out_d[si, off + qi, : len(d)] = d
+        if fault_log is not None:
+            for i, e in sorted(failed.items()):
+                fault_log.append(FaultRecord.from_error(e, partition=i))
+            if fused_err is not None:
+                fault_log.append(FaultRecord.from_error(fused_err))
         return [[(out_s[si, o: o + n], out_d[si, o: o + n])
                  for o, n in spans] for si in range(S)]
 
     def search_bool(self, queries: Sequence[dict], k: int = 10,
-                    check=None):
+                    check=None, fault_log=None):
         """per[si] = (scores [Q, k] f32, ords [Q, k] i32), matching each
         turbo's solo search_bool bitwise. Partitions may route the same
         query differently (device vs host): the fused sweep dispatches
         the UNION of device-routed queries with all-zero weight rows for
         partitions that host-route one — inert because the kernels score
-        query columns independently."""
+        query columns independently.
+
+        Fault containment mirrors search_many: a faulted partition (or a
+        faulted fused chunk) serves its queries through _bool_host_exact,
+        which every device bool result is bit-identical to anyway."""
         Q = len(queries)
         S = len(self.turbos)
         out_s = np.zeros((S, Q, k), np.float32)
         out_d = np.zeros((S, Q, k), np.int32)
         resolved = [[t._resolve_bool(spec) for spec in queries]
                     for t in self.turbos]
+        failed: Dict[int, DeviceFaultError] = {}
         routes = []
         for si, t in enumerate(self.turbos):
-            t._ensure_bool(resolved[si])
-            routes.append(t._bool_routes(resolved[si]))
+            try:
+                t._ensure_bool(resolved[si])
+                self._refresh_part(si)
+                routes.append(t._bool_routes(resolved[si]))
+            except DeviceFaultError as e:
+                failed[si] = e
+                # every resolvable query host-routes for this partition
+                routes.append(([], [qi for qi, r in enumerate(resolved[si])
+                                    if r is not None]))
             t.stats["bool_device"] += len(routes[si][0])
-        self._refresh()
         dev_sets = [set(dev) for dev, _ in routes]
         union = sorted({qi for ds in dev_sets for qi in ds})
         n_rows = max(_GLOBAL_ROWS, k + 5)
         pending = []
+        fused_err: Optional[DeviceFaultError] = None
         off = 0
         while off < len(union):
             rem = len(union) - off
@@ -1543,22 +1700,36 @@ class ShardedTurbo:
             sel = union[off: off + take]
             if check is not None:
                 check()
-            pending.append((sel, self._dispatch_bool(
-                resolved, dev_sets, sel, take, n_rows)))
+            try:
+                packed_dev = self._dispatch_bool(
+                    resolved, dev_sets, sel, take, n_rows)
+            except DeviceFaultError as e:
+                packed_dev, fused_err = None, e
+            pending.append((sel, packed_dev))
             off += len(sel)
         for sel, packed_dev in pending:
             if check is not None:
                 check()
-            packed = np.asarray(packed_dev)
+            packed = None
+            if packed_dev is not None:
+                try:
+                    with faults.device_errors("fused_dispatch"):
+                        packed = np.asarray(packed_dev)
+                except DeviceFaultError as e:
+                    packed, fused_err = None, e
             for si, t in enumerate(self.turbos):
-                rows_all = packed[si, :, :n_rows].astype(np.int64)
-                bounds = packed[si, :, n_rows]
+                if packed is not None:
+                    rows_all = packed[si, :, :n_rows].astype(np.int64)
+                    bounds = packed[si, :, n_rows]
                 for j, qi in enumerate(sel):
                     if qi not in dev_sets[si]:
                         continue
-                    docs = t._collect_docs(rows_all[j])
-                    s, d = t._finish_bool(resolved[si][qi], docs,
-                                          float(bounds[j]), k)
+                    if packed is None:
+                        s, d = t._bool_host_exact(resolved[si][qi], k)
+                    else:
+                        docs = t._collect_docs(rows_all[j])
+                        s, d = t._finish_bool(resolved[si][qi], docs,
+                                              float(bounds[j]), k)
                     out_s[si, qi, : len(s)] = s
                     out_d[si, qi, : len(d)] = d
         for si, t in enumerate(self.turbos):
@@ -1568,4 +1739,9 @@ class ShardedTurbo:
                 s, d = t._bool_host_exact(resolved[si][qi], k)
                 out_s[si, qi, : len(s)] = s
                 out_d[si, qi, : len(d)] = d
+        if fault_log is not None:
+            for i, e in sorted(failed.items()):
+                fault_log.append(FaultRecord.from_error(e, partition=i))
+            if fused_err is not None:
+                fault_log.append(FaultRecord.from_error(fused_err))
         return [(out_s[si], out_d[si]) for si in range(S)]

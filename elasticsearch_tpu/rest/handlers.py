@@ -977,6 +977,11 @@ class _Handlers:
         for p in ("size", "from"):
             if req.param(p) is not None:
                 body[p] = req.param_int(p)
+        if req.param("timeout") is not None:
+            body["timeout"] = req.param("timeout")
+        if req.param("allow_partial_search_results") is not None:
+            body["allow_partial_search_results"] = \
+                req.param_bool("allow_partial_search_results")
         # point-in-time searches carry their index inside the pinned context
         pit = body.get("pit")
         if pit:
@@ -1896,6 +1901,7 @@ class _Handlers:
                 "thread_pool": self.node.thread_pool.stats(),
                 "tpu_coalescer": _default_coalescer_stats(),
                 "tpu_turbo": _turbo_merge_stats(),
+                "tpu_health": _tpu_health_stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
         })
@@ -2193,6 +2199,22 @@ def _turbo_merge_stats() -> dict:
     from elasticsearch_tpu.search.serving import turbo_node_stats
 
     return turbo_node_stats()
+
+
+def _tpu_health_stats() -> dict:
+    """Node-wide device-health section (PR 5): per-engine circuit state
+    + cumulative fault/fallback counters, plus the serving layer's
+    containment counters (recovered shards, fast-path rejections/timeouts)
+    and the coalescer's poison-batch retries."""
+    from elasticsearch_tpu.common.health import node_health_stats
+    from elasticsearch_tpu.search.serving import serving_fault_stats
+    from elasticsearch_tpu.threadpool.coalescer import default_coalescer
+
+    out = node_health_stats()
+    out.update(serving_fault_stats())
+    out["coalesce_batch_retries"] = \
+        default_coalescer().stats()["coalesce_batch_retries"]
+    return out
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

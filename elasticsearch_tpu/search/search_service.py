@@ -40,7 +40,7 @@ def execute_search(
     took = int((time.monotonic() - start) * 1000)
     resp = {
         "took": took,
-        "timed_out": False,
+        "timed_out": bool(getattr(qr, "timed_out", False)),
         "_shards": {"total": 1, "successful": 1, "skipped": 0, "failed": 0},
         "hits": {
             "total": {"value": qr.total, "relation": qr.relation},

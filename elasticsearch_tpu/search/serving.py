@@ -44,19 +44,63 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.common.errors import (
+    DeviceFaultError, SearchPhaseExecutionError,
+)
+from elasticsearch_tpu.common.faults import FaultRecord
 from elasticsearch_tpu.index.positions import phrase_freqs
 from elasticsearch_tpu.ops import bm25_idf
 from elasticsearch_tpu.search import queries as q
 from elasticsearch_tpu.search.queries import parse_query
-from elasticsearch_tpu.tasks.task_manager import TaskCancelledError
+from elasticsearch_tpu.tasks.task_manager import (
+    Deadline, DispatchDeadlineError, TaskCancelledError, parse_timeout_ms,
+)
 
 K1 = 1.2
 B = 0.75
 
 # request keys the fast path understands; anything else -> dense fallback
 _ALLOWED_KEYS = {"query", "size", "from", "_source", "stored_fields",
-                 "track_total_hits", "version", "seq_no_primary_term"}
+                 "track_total_hits", "version", "seq_no_primary_term",
+                 "timeout", "allow_partial_search_results"}
 _MAX_K = 1000
+
+# serving-path fault/containment counters (GET /_nodes/stats tpu_health)
+_SERVING_STATS = {"fastpath_reject_error": 0, "fastpath_device_fault": 0,
+                  "fastpath_timed_out": 0, "shard_fault_recoveries": 0}
+_SERVING_LOCK = threading.Lock()
+_LOGGED_REJECT_TYPES: set = set()
+
+
+def serving_fault_stats() -> dict:
+    with _SERVING_LOCK:
+        return dict(_SERVING_STATS)
+
+
+def _count_serving(key: str, n: int = 1) -> None:
+    with _SERVING_LOCK:
+        _SERVING_STATS[key] += n
+
+
+def _note_reject_error(e: BaseException, where: str) -> None:
+    """The fast path keeps its fall-back-to-dense contract on unexpected
+    errors, but no longer SILENTLY: each one is counted
+    (fastpath_reject_error) and the first occurrence of each (site, type)
+    is logged with a traceback, so real bugs stop masquerading as "query
+    not eligible"."""
+    _count_serving("fastpath_reject_error")
+    tname = type(e).__name__
+    with _SERVING_LOCK:
+        if (where, tname) in _LOGGED_REJECT_TYPES:
+            return
+        _LOGGED_REJECT_TYPES.add((where, tname))
+    import logging
+
+    logging.getLogger("search.serving").warning(
+        "fast path hit an unexpected %s at %s (%s) — falling back to the "
+        "dense executor; further %s errors here are counted, not logged",
+        tname, where, e, tname, exc_info=True)
 
 
 # --------------------------------------------------------------------------
@@ -110,7 +154,8 @@ def extract_plan(request: dict, mapper) -> Optional[FlatPlan]:
         _flatten(query, plan, mapper, ctx="top", weight=1.0)
     except _Reject:
         return None
-    except Exception:
+    except Exception as e:
+        _note_reject_error(e, "extract_plan")
         return None
     if not (plan.is_disjunctive or plan.is_conjunctive):
         return None
@@ -348,9 +393,14 @@ class TurboEngine:
     kind = "turbo"
 
     def __init__(self, turbos: Sequence, mesh=None):
+        from elasticsearch_tpu.common.health import EngineHealth
+
         self.turbos = list(turbos)
+        for i, t in enumerate(self.turbos):
+            t.part_id = i          # fault-site attribution per partition
         self.mesh = mesh
         self._sharded = None
+        self.health = EngineHealth("turbo")
         self.merge_stats = {"merge_device": 0, "merge_host": 0,
                             "partition_dispatches": 0,
                             "fused_dispatches": 0}
@@ -371,33 +421,89 @@ class TurboEngine:
             self._sharded = ShardedTurbo(self.turbos, self.mesh)
         return self._sharded
 
-    def search_many(self, batches: Sequence[List], k: int = 10, check=None):
-        fused = self._fused()
-        if fused is not None:
-            n0 = fused.fused_dispatches
-            per = fused.search_many(batches, k=k, check=check)
-            self._count("fused_dispatches", fused.fused_dispatches - n0)
-            self._count("partition_dispatches",
-                        (fused.fused_dispatches - n0) * len(self.turbos))
-        else:
-            per = [t.search_many(batches, k=k, check=check)
-                   for t in self.turbos]
-        return [self._merge_parts([p[bi] for p in per], len(batch), k,
-                                  device=fused is not None)
+    def _host_tier_many(self, batches, k, check):
+        """Whole-engine host-exact tier (circuit open / catastrophic
+        fault): zero device dispatches, merged via the _merge3 host
+        reference — bit-identical to the device route."""
+        per = [t.search_many_host(batches, k=k, check=check)
+               for t in self.turbos]
+        return [self._merge3([p[bi] for p in per], len(batch), k)
                 for bi, batch in enumerate(batches)]
 
-    def _merge_parts(self, per, Q: int, k: int, device: bool):
+    def _health_account(self, log, n0: int) -> None:
+        """One dispatch's containment outcome -> circuit state: any NEW
+        fault record counts as a device fault (consecutive faults trip
+        the breaker), a clean dispatch resets the streak / closes a
+        half-open probe."""
+        new = log[n0:]
+        if new:
+            self.health.record_fault(new[-1].error)
+        else:
+            self.health.record_success()
+
+    def search_many(self, batches: Sequence[List], k: int = 10, check=None,
+                    fault_log=None):
+        log = fault_log if fault_log is not None else []
+        n0 = len(log)
+        nq = sum(len(b) for b in batches)
+        if not self.health.allow_device():
+            self.health.record_fallback(nq)
+            return self._host_tier_many(batches, k, check)
+        fused = self._fused()
+        try:
+            if fused is not None:
+                d0 = fused.fused_dispatches
+                per = fused.search_many(batches, k=k, check=check,
+                                        fault_log=log)
+                self._count("fused_dispatches", fused.fused_dispatches - d0)
+                self._count("partition_dispatches",
+                            (fused.fused_dispatches - d0) * len(self.turbos))
+            else:
+                # mesh-less S >= 1: per-partition isolation lives here —
+                # a faulted partition is host-scored, its peers keep the
+                # device path
+                per = []
+                for t in self.turbos:
+                    try:
+                        per.append(t.search_many(batches, k=k, check=check))
+                    except DeviceFaultError as e:
+                        log.append(FaultRecord.from_error(
+                            e, partition=t.part_id))
+                        per.append(t.search_many_host(batches, k=k,
+                                                      check=check))
+        except DeviceFaultError as e:
+            log.append(FaultRecord.from_error(e))
+            self.health.record_fault(e)
+            self.health.record_fallback(nq)
+            return self._host_tier_many(batches, k, check)
+        out = [self._merge_parts([p[bi] for p in per], len(batch), k,
+                                 device=fused is not None, fault_log=log)
+               for bi, batch in enumerate(batches)]
+        self._health_account(log, n0)
+        return out
+
+    def _merge_parts(self, per, Q: int, k: int, device: bool,
+                     fault_log=None):
         """Merge per-partition (scores, docs) into the engine-wide
         (scores, partition, ord) contract — on device when the fused
-        path is active, through the host _merge3 reference otherwise."""
+        path is active, through the host _merge3 reference otherwise.
+        A faulted device merge degrades to _merge3 (bit-identical: the
+        device merge only permutes the same exact f32 scores)."""
         if len(per) > 1 and device and Q > 0:
-            from elasticsearch_tpu.parallel.spmd import merge_partition_topk
+            try:
+                with faults.device_dispatch("merge_kernel"):
+                    from elasticsearch_tpu.parallel.spmd import (
+                        merge_partition_topk,
+                    )
 
-            scores = np.stack([s for s, _ in per])
-            ords = np.stack([d for _, d in per])
-            out = merge_partition_topk(self.mesh, scores, ords, k)
-            self._count("merge_device")
-            return out
+                    scores = np.stack([s for s, _ in per])
+                    ords = np.stack([d for _, d in per])
+                    out = merge_partition_topk(self.mesh, scores, ords, k)
+                self._count("merge_device")
+                return out
+            except DeviceFaultError as e:
+                if fault_log is not None:
+                    fault_log.append(FaultRecord.from_error(e))
         if len(per) > 1 and Q > 0:
             self._count("merge_host")
         return self._merge3(per, Q, k)
@@ -426,31 +532,60 @@ class TurboEngine:
         return out_s, out_p, out_o
 
     def search_bool(self, queries: Sequence[dict], k: int = 10,
-                    check=None):
+                    check=None, fault_log=None):
         """Batched bool top-k through the per-partition conjunctive
         sweeps — the BlockMax search_bool contract:
-        (scores [Q,k], partition [Q,k], ord [Q,k])."""
-        fused = self._fused()
-        if fused is not None:
-            n0 = fused.fused_dispatches
-            per = fused.search_bool(queries, k=k, check=check)
-            self._count("fused_dispatches", fused.fused_dispatches - n0)
-            self._count("partition_dispatches",
-                        (fused.fused_dispatches - n0) * len(self.turbos))
-        else:
-            per = [t.search_bool(queries, k=k, check=check)
+        (scores [Q,k], partition [Q,k], ord [Q,k]). Fault containment
+        mirrors search_many (circuit-open / catastrophic -> the
+        _bool_host_exact tier, per-partition isolation otherwise)."""
+        log = fault_log if fault_log is not None else []
+        n0 = len(log)
+        if not self.health.allow_device():
+            self.health.record_fallback(len(queries))
+            per = [t.search_bool_host(queries, k=k, check=check)
                    for t in self.turbos]
-        return self._merge_parts(per, len(queries), k,
-                                 device=fused is not None)
+            return self._merge3(per, len(queries), k)
+        fused = self._fused()
+        try:
+            if fused is not None:
+                d0 = fused.fused_dispatches
+                per = fused.search_bool(queries, k=k, check=check,
+                                        fault_log=log)
+                self._count("fused_dispatches", fused.fused_dispatches - d0)
+                self._count("partition_dispatches",
+                            (fused.fused_dispatches - d0) * len(self.turbos))
+            else:
+                per = []
+                for t in self.turbos:
+                    try:
+                        per.append(t.search_bool(queries, k=k, check=check))
+                    except DeviceFaultError as e:
+                        log.append(FaultRecord.from_error(
+                            e, partition=t.part_id))
+                        per.append(t.search_bool_host(queries, k=k,
+                                                      check=check))
+        except DeviceFaultError as e:
+            log.append(FaultRecord.from_error(e))
+            self.health.record_fault(e)
+            self.health.record_fallback(len(queries))
+            per = [t.search_bool_host(queries, k=k, check=check)
+                   for t in self.turbos]
+            return self._merge3(per, len(queries), k)
+        out = self._merge_parts(per, len(queries), k,
+                                device=fused is not None, fault_log=log)
+        self._health_account(log, n0)
+        return out
 
     def search_phrase(self, phrases: Sequence[List[str]], k: int = 10,
-                      slop: int = 0, check=None):
+                      slop: int = 0, check=None, fault_log=None):
         """Batched match_phrase top-k; slop-0 rides the adjacency
         columns, other slops the exact host positional path. Sugar over
         search_bool (exactly what each turbo's search_phrase is) so the
-        fused dispatch + device merge apply here too."""
+        fused dispatch + device merge — and the fault containment —
+        apply here too."""
         specs = [{"phrases": [(list(p), int(slop), 1.0)]} for p in phrases]
-        return self.search_bool(specs, k=k, check=check)
+        return self.search_bool(specs, k=k, check=check,
+                                fault_log=fault_log)
 
     def hbm_bytes(self) -> int:
         total = 0
@@ -472,6 +607,8 @@ class TurboEngine:
             for key, v in t.stats.items():
                 agg[key] = agg.get(key, 0) + v
         agg.update(self.merge_stats)
+        # flat numeric health_* keys (bench stats_delta subtracts values)
+        agg.update(self.health.flat_stats())
         return agg
 
 
@@ -872,10 +1009,17 @@ class ServingContext:
             try:
                 if task is not None:
                     task.check()
-                out[i] = self._conjunctive(plan, snap, requests[i], start)
+                out[i] = self._conjunctive(plan, snap, requests[i], start,
+                                           task=task)
             except TaskCancelledError:
                 raise
-            except Exception:
+            except SearchPhaseExecutionError as e:
+                # allow_partial_search_results=false with a faulted shard:
+                # a request-level error, NOT a dense retry (the caller
+                # renders the exception object in this body's slot)
+                out[i] = e
+            except Exception as e:
+                _note_reject_error(e, "conjunctive")
                 out[i] = None
         for field, idxs in by_field.items():
             try:
@@ -886,8 +1030,8 @@ class ServingContext:
                     out[i] = r
             except TaskCancelledError:
                 raise
-            except Exception:
-                pass
+            except Exception as e:
+                _note_reject_error(e, "disjunctive_batch")
         return out
 
     def try_query_phase(self, request: dict, task=None):
@@ -912,19 +1056,39 @@ class ServingContext:
         if snap.total_docs == 0:
             return None
         k = int(request.get("from", 0)) + int(request.get("size", 10))
-        check = task.check if task is not None else None
+        deadline = self._deadline_for(request)
+        check = self._combined_check(task, [deadline])
+        flog: List[FaultRecord] = []
+        timed_out = QuerySearchResult(total=0, relation="gte", hits=[],
+                                      max_score=None, timed_out=True)
         if plan.is_disjunctive:
             if not self._disj_servable(plan, snap, request):
                 return None
             eng = snap.engine(plan.field)
+            health = (getattr(eng, "health", None)
+                      if getattr(eng, "kind", "") != "turbo" else None)
+            if health is not None and not health.allow_device():
+                health.record_fallback(1)
+                return None             # circuit open: dense executor tier
             # single-query dispatches ride the node's coalescer: concurrent
             # shard queries on the same engine share ONE device dispatch
             from elasticsearch_tpu.threadpool.coalescer import (
                 default_coalescer,
             )
 
-            scores, parts, ords = default_coalescer().dispatch(
-                eng, [plan.disj], k, check=check)
+            try:
+                scores, parts, ords = default_coalescer().dispatch(
+                    eng, [plan.disj], k, check=check, fault_log=flog)
+            except DispatchDeadlineError:
+                _count_serving("fastpath_timed_out")
+                return timed_out
+            except DeviceFaultError as e:
+                if health is not None:
+                    health.record_fault(e)
+                _count_serving("fastpath_device_fault")
+                return None             # dense executor serves this one
+            if health is not None:
+                health.record_success()
             total_rel = self._disj_total
         elif plan.is_conjunctive and plan.field is not None:
             # conjunctive / phrase plans serve through the same engine
@@ -936,13 +1100,19 @@ class ServingContext:
             spec = _turbo_bool_spec(plan)
             if spec is None:
                 return None
-            scores, parts, ords = eng.search_bool([spec], k=k,
-                                                  check=check)
+            try:
+                scores, parts, ords = eng.search_bool(
+                    [spec], k=k, check=check, fault_log=flog)
+            except DispatchDeadlineError:
+                _count_serving("fastpath_timed_out")
+                return timed_out
 
             def total_rel(p, sn, req, n):
                 return self._conj_total(p, sn, req)
         else:
             return None
+        if flog:
+            _count_serving("shard_fault_recoveries", len(flog))
         hits = []
         max_score = None
         for j in range(k):
@@ -955,8 +1125,9 @@ class ServingContext:
                                  global_ord=part.base + o))
             max_score = s if max_score is None else max(max_score, s)
         total, relation = total_rel(plan, snap, request, len(hits))
-        return QuerySearchResult(total=total, relation=relation, hits=hits,
-                                 max_score=max_score)
+        return QuerySearchResult(
+            total=total, relation=relation, hits=hits, max_score=max_score,
+            timed_out=bool(deadline is not None and deadline.expired))
 
     # ---- disjunctive (device) ----
 
@@ -965,19 +1136,74 @@ class ServingContext:
         max_docs = max(p.segment.n_docs for p in snap.partitions)
         return k <= max_docs
 
+    @staticmethod
+    def _deadline_for(request) -> Optional[Deadline]:
+        """Request timeout -> Deadline (None when no timeout is set)."""
+        t = request.get("timeout")
+        if t is None:
+            return None
+        ms = parse_timeout_ms(t)
+        return Deadline(ms) if ms is not None else None
+
+    @staticmethod
+    def _combined_check(task, deadlines):
+        """Cooperative check threaded into engine dispatches: task
+        cancellation raises as before; an expired request deadline raises
+        DispatchDeadlineError so a hung dispatch yields timed_out partial
+        results instead of a stuck search-pool worker."""
+        tcheck = task.check if task is not None else None
+        dls = [d for d in deadlines if d is not None]
+        if tcheck is None and not dls:
+            return None
+
+        def check():
+            if tcheck is not None:
+                tcheck()
+            for d in dls:
+                if d.expired:
+                    raise DispatchDeadlineError()
+        return check
+
     def _disjunctive_batch(self, field: str, plans, requests, snap, task=None):
         start = time.monotonic()
         bm = snap.engine(field)
         k = max(int(r.get("from", 0)) + int(r.get("size", 10))
                 for r in requests)
         queries = [p.disj for p in plans]
-        check = task.check if task is not None else None
+        deadlines = [self._deadline_for(r) for r in requests]
+        check = self._combined_check(task, deadlines)
+        # TurboEngine degrades itself (internal circuit + host tier);
+        # engines that can't (BlockMax) get the circuit enforced here,
+        # with the dense executor as their fallback tier
+        health = (getattr(bm, "health", None)
+                  if getattr(bm, "kind", "") != "turbo" else None)
+        if health is not None and not health.allow_device():
+            health.record_fallback(len(queries))
+            return [None] * len(requests)
+        flog: List[FaultRecord] = []
         # small batches coalesce with concurrent dispatches on the same
         # engine (threadpool/coalescer); large msearch batches go direct
         from elasticsearch_tpu.threadpool.coalescer import default_coalescer
 
-        scores, parts, ords = default_coalescer().dispatch(
-            bm, queries, k, check=check)
+        try:
+            scores, parts, ords = default_coalescer().dispatch(
+                bm, queries, k, check=check, fault_log=flog)
+        except DispatchDeadlineError:
+            _count_serving("fastpath_timed_out")
+            # expired requests report timed_out partials; the rest re-run
+            # on the dense executor
+            return [self._timed_out_response(r, snap, start)
+                    if d is not None and d.timed_out else None
+                    for r, d in zip(requests, deadlines)]
+        except DeviceFaultError as e:
+            if health is not None:
+                health.record_fault(e)
+            _count_serving("fastpath_device_fault")
+            return [None] * len(requests)
+        if health is not None:
+            health.record_success()
+        if flog:
+            _count_serving("shard_fault_recoveries", len(flog))
         results = []
         for qi, (plan, request) in enumerate(zip(plans, requests)):
             hits = []
@@ -987,8 +1213,14 @@ class ServingContext:
                 hits.append((int(parts[qi, j]), int(ords[qi, j]),
                              float(scores[qi, j])))
             total, relation = self._disj_total(plan, snap, request, len(hits))
-            results.append(self._respond(request, snap, hits, total,
-                                         relation, start))
+            d = deadlines[qi]
+            try:
+                results.append(self._respond(
+                    request, snap, hits, total, relation, start,
+                    timed_out=bool(d is not None and d.expired),
+                    faults=flog))
+            except SearchPhaseExecutionError as e:
+                results.append(e)
         return results
 
     def _disj_total(self, plan, snap, request, n_found) -> Tuple[int, str]:
@@ -1042,8 +1274,9 @@ class ServingContext:
             return track_n, "gte"
         return total, "eq"
 
-    def _conjunctive(self, plan, snap, request, start):
+    def _conjunctive(self, plan, snap, request, start, task=None):
         k = int(request.get("from", 0)) + int(request.get("size", 10))
+        deadline = self._deadline_for(request)
         eng = snap.engine(plan.field) if plan.field else None
         spec = _turbo_bool_spec(plan) \
             if getattr(eng, "kind", "") == "turbo" else None
@@ -1051,7 +1284,16 @@ class ServingContext:
             # the flagship engine serves the hits (conjunctive sweep over
             # the int8 columns, bit-identical rescore); totals come from
             # the same count the host path would have produced
-            scores, parts, ords = eng.search_bool([spec], k=k)
+            check = self._combined_check(task, [deadline])
+            flog: List[FaultRecord] = []
+            try:
+                scores, parts, ords = eng.search_bool(
+                    [spec], k=k, check=check, fault_log=flog)
+            except DispatchDeadlineError:
+                _count_serving("fastpath_timed_out")
+                return self._timed_out_response(request, snap, start)
+            if flog:
+                _count_serving("shard_fault_recoveries", len(flog))
             hits = []
             for j in range(k):
                 s = float(scores[0, j])
@@ -1059,11 +1301,18 @@ class ServingContext:
                     break
                 hits.append((int(parts[0, j]), int(ords[0, j]), s))
             total, relation = self._conj_total(plan, snap, request)
-            return self._respond(request, snap, hits, total, relation,
-                                 start)
+            return self._respond(
+                request, snap, hits, total, relation, start,
+                timed_out=bool(deadline is not None and deadline.expired),
+                faults=flog)
         all_s, all_p, all_o = [], [], []
         total = 0
+        timed_out = False
         for pi, part in enumerate(snap.partitions):
+            if deadline is not None and deadline.expired:
+                # partial results over the partitions scored so far
+                timed_out = True
+                break
             r = _conjunctive_partition(plan, snap, part)
             if r is None:
                 continue
@@ -1090,13 +1339,70 @@ class ServingContext:
             track_n = 1 << 62 if track is True else int(track)
             relation = "eq" if total <= track_n else "gte"
             total = min(total, track_n)
-        return self._respond(request, snap, hits, total, relation, start)
+        return self._respond(request, snap, hits, total, relation, start,
+                             timed_out=timed_out)
 
     # ---- response assembly ----
 
-    def _respond(self, request, snap, hits, total, relation, start):
+    def _timed_out_response(self, request, snap, start):
+        """Empty partial response for a request whose deadline expired
+        before any dispatch completed."""
+        return self._respond(request, snap, [], 0, "gte", start,
+                             timed_out=True)
+
+    def _shards_section(self, snap, faults_log) -> dict:
+        """`_shards` accounting that reflects reality: shards whose device
+        dispatch faulted are reported as failures (with a reason entry),
+        recovered ones still count as successful (the host tier re-scored
+        them bit-identically)."""
+        n_shards = len(self.svc.shards)
+        out = {"total": n_shards, "successful": n_shards, "skipped": 0,
+               "failed": 0}
+        if not faults_log:
+            return out
+        failures = []
+        seen = set()
+        for fr in faults_log:
+            pi = fr.partition
+            if pi is not None and 0 <= pi < len(snap.partitions):
+                sid = snap.partitions[pi].shard_id
+            else:
+                sid = 0
+            key = (sid, fr.site)
+            if key in seen:
+                continue
+            seen.add(key)
+            err = fr.error
+            failures.append({
+                "shard": sid,
+                "index": self.svc.name,
+                "status": "recovered" if fr.recovered else "failed",
+                "reason": {
+                    "type": getattr(err, "error_type",
+                                    type(err).__name__),
+                    "reason": str(err),
+                    **({"site": fr.site} if fr.site else {}),
+                },
+            })
+        hard = sum(1 for f in failures if f["status"] == "failed")
+        out["failed"] = hard
+        out["successful"] = n_shards - min(hard, n_shards)
+        out["failures"] = failures
+        return out
+
+    def _respond(self, request, snap, hits, total, relation, start,
+                 timed_out=False, faults=None):
         from elasticsearch_tpu.search.fetch_phase import execute_fetch_phase
         from elasticsearch_tpu.search.query_phase import ShardHit
+
+        if faults and request.get("allow_partial_search_results", True) \
+                is False:
+            first = faults[0]
+            raise SearchPhaseExecutionError(
+                f"shard failure during [{first.site}]: {first.error} "
+                "(allow_partial_search_results=false)",
+                failures=[{"site": fr.site, "partition": fr.partition,
+                           "reason": str(fr.error)} for fr in faults])
 
         from_ = int(request.get("from", 0))
         size = int(request.get("size", 10))
@@ -1114,12 +1420,10 @@ class ServingContext:
                 hit["_score"] = score
             out_hits.append(hit)
         took = int((time.monotonic() - start) * 1000)
-        n_shards = len(self.svc.shards)
         resp = {
             "took": took,
-            "timed_out": False,
-            "_shards": {"total": n_shards, "successful": n_shards,
-                        "skipped": 0, "failed": 0},
+            "timed_out": bool(timed_out),
+            "_shards": self._shards_section(snap, faults),
             "hits": {
                 "total": {"value": total, "relation": relation},
                 "max_score": max_score,

@@ -167,6 +167,12 @@ def parse_timeout_ms(value) -> Optional[float]:
     return float(s)
 
 
+class DispatchDeadlineError(Exception):
+    """Raised from a dispatch-side deadline check (the `check` callable
+    threaded into engine dispatches) when the request `Deadline` expires
+    mid-dispatch; the serving layer converts it to timed_out partials."""
+
+
 class Deadline:
     """Per-request soft deadline for timeout/terminate_after semantics
     (ref: search/internal/ContextIndexSearcher timeout runnable +

@@ -69,9 +69,30 @@ def _env_window_us() -> float:
         return DEFAULT_WINDOW_US
 
 
+def _accepts_fault_log(engine) -> bool:
+    """Whether engine.search_many takes a fault_log kwarg (TurboEngine
+    does; BlockMax and test stubs may not). Cached on the engine."""
+    cached = getattr(engine, "_accepts_fault_log_", None)
+    if cached is None:
+        import inspect
+
+        try:
+            params = inspect.signature(engine.search_many).parameters
+            cached = "fault_log" in params or any(
+                p.kind == inspect.Parameter.VAR_KEYWORD
+                for p in params.values())
+        except (TypeError, ValueError):
+            cached = False
+        try:
+            engine._accepts_fault_log_ = cached
+        except AttributeError:
+            pass
+    return cached
+
+
 class _PendingBatch:
     __slots__ = ("engine", "k", "queries", "closed", "fill", "done",
-                 "results", "error")
+                 "results", "error", "fault_log", "query_errors")
 
     def __init__(self, engine, k: int):
         self.engine = engine
@@ -82,6 +103,8 @@ class _PendingBatch:
         self.done = threading.Event()    # results ready for the waiters
         self.results = None
         self.error: Optional[BaseException] = None
+        self.fault_log: List = []        # shard fault records (recovered)
+        self.query_errors: Dict[int, BaseException] = {}  # slot -> error
 
 
 class DispatchCoalescer:
@@ -104,16 +127,29 @@ class DispatchCoalescer:
         self._coalesced_dispatches = 0
         self._coalesced_queries = 0
         self._largest_batch = 0
+        self._batch_retries = 0
 
     def window_us(self) -> float:
         return self._window_us if self._window_us is not None \
             else _env_window_us()
 
-    def dispatch(self, engine, queries: List, k: int, check=None):
+    @staticmethod
+    def _run(engine, queries: List, k: int, check=None, fault_log=None):
+        kw = {}
+        if check is not None:
+            kw["check"] = check
+        if fault_log is not None and _accepts_fault_log(engine):
+            kw["fault_log"] = fault_log
+        return engine.search_many([list(queries)], k=k, **kw)[0]
+
+    def dispatch(self, engine, queries: List, k: int, check=None,
+                 fault_log=None):
         """One batch of queries -> (scores [Q,k], partition [Q,k],
         ord [Q,k]) — the engine `search_many` single-batch contract.
         Small batches coalesce with concurrent peers; large ones (or a
-        zero window) dispatch directly."""
+        zero window) dispatch directly. `fault_log`, when given, collects
+        the engine's recovered-shard FaultRecords for `_shards`
+        accounting."""
         window_s = self.window_us() / 1e6
         if check is not None:
             # cooperative cancellation happens at the caller's boundary:
@@ -123,7 +159,8 @@ class DispatchCoalescer:
         if window_s <= 0 or len(queries) > self.small_batch_max:
             with self._lock:
                 self._direct_dispatches += 1
-            return engine.search_many([list(queries)], k=k, check=check)[0]
+            return self._run(engine, queries, k, check=check,
+                             fault_log=fault_log)
 
         with self._lock:
             # key under the lock so one engine gets exactly one serial
@@ -154,8 +191,14 @@ class DispatchCoalescer:
                 if n > self._largest_batch:
                     self._largest_batch = n
             try:
-                batch.results = engine.search_many([batch.queries],
-                                                   k=batch.k)[0]
+                batch.results = self._run(engine, batch.queries, batch.k,
+                                          fault_log=batch.fault_log)
+            except Exception as e:
+                # poison-batch containment: a failed FUSED dispatch must
+                # not fail every waiter — retry each query solo once so
+                # only the query (if any) that actually trips the fault
+                # sees the error
+                self._retry_solo(batch, e)
             except BaseException as e:  # noqa: BLE001 — ferried to waiters
                 batch.error = e
             finally:
@@ -166,9 +209,47 @@ class DispatchCoalescer:
             check()
         if batch.error is not None:
             raise batch.error
+        if fault_log is not None and batch.fault_log:
+            fault_log.extend(batch.fault_log)
+        if batch.query_errors:
+            for qi in range(base, base + len(queries)):
+                if qi in batch.query_errors:
+                    raise batch.query_errors[qi]
         scores, parts, ords = batch.results
         sl = slice(base, base + len(queries))
         return scores[sl], parts[sl], ords[sl]
+
+    def _retry_solo(self, batch: _PendingBatch,
+                    original: BaseException) -> None:
+        """Re-run each of a failed merged batch's queries as its own solo
+        dispatch (once). Slots whose retry also fails carry their error to
+        exactly their waiter; if every retry fails the original batch
+        error goes to everyone."""
+        import numpy as np
+
+        with self._lock:
+            self._batch_retries += 1
+        rows: List = [None] * len(batch.queries)
+        errors: Dict[int, BaseException] = {}
+        for qi, query in enumerate(batch.queries):
+            try:
+                s, p, o = self._run(batch.engine, [query], batch.k,
+                                    fault_log=batch.fault_log)
+            except Exception as e:
+                errors[qi] = e
+                continue
+            rows[qi] = (np.asarray(s[0]), np.asarray(p[0]),
+                        np.asarray(o[0]))
+        if all(r is None for r in rows):
+            batch.error = original
+            return
+        template = next(r for r in rows if r is not None)
+        for qi, r in enumerate(rows):
+            if r is None:
+                rows[qi] = tuple(np.zeros_like(x) for x in template)
+        batch.results = tuple(np.stack([r[j] for r in rows])
+                              for j in range(3))
+        batch.query_errors = errors
 
     def stats(self) -> dict:
         with self._lock:
@@ -182,6 +263,7 @@ class DispatchCoalescer:
                 "largest_batch": self._largest_batch,
                 "mean_batch": round(merged / dispatches, 3) if dispatches
                 else 0.0,
+                "coalesce_batch_retries": self._batch_retries,
             }
 
 

@@ -84,3 +84,67 @@ def test_hierarchy_breaker_parent_enforced():
     with pytest.raises(CircuitBreakingError):
         req.add_estimate_bytes_and_maybe_break(501, "r2")
     assert svc.get_breaker("request").used_bytes == 500
+
+
+def test_parent_trip_rolls_back_child_accounting():
+    """A parent-level trip must leave the CHILD's accounting untouched:
+    the child tentatively adds, the parent refuses, the child rolls
+    back — repeated refusals never leak reserved bytes."""
+    parent = CircuitBreaker("parent", limit_bytes=1000)
+    child = CircuitBreaker("request", limit_bytes=10_000, parent=parent)
+    child.add_estimate_bytes_and_maybe_break(900, "warm")
+    for _ in range(5):
+        with pytest.raises(CircuitBreakingError):
+            child.add_estimate_bytes_and_maybe_break(200, "over")
+    assert child.used_bytes == 900
+    assert parent.used_bytes == 900
+    assert parent.trip_count == 5
+    assert child.trip_count == 0          # the PARENT tripped, not it
+    child.release(900)
+    assert child.used_bytes == 0 and parent.used_bytes == 0
+
+
+def test_breaker_concurrent_adds_consistent_accounting():
+    """Threads racing add_estimate_bytes_and_maybe_break against child +
+    parent limits: every ACCEPTED reservation is fully accounted on both
+    levels, every REFUSED one fully rolled back — no partial states,
+    and trip counts equal the number of refusals."""
+    import threading
+
+    parent = CircuitBreaker("parent", limit_bytes=50_000)
+    children = [CircuitBreaker(f"c{i}", limit_bytes=30_000, parent=parent)
+                for i in range(2)]
+    accepted = [0, 0]
+    refused = [0]
+    lock = threading.Lock()
+    barrier = threading.Barrier(8)
+
+    def worker(ci):
+        barrier.wait(timeout=10)
+        for _ in range(200):
+            try:
+                children[ci].add_estimate_bytes_and_maybe_break(100, "w")
+                with lock:
+                    accepted[ci] += 100
+            except CircuitBreakingError:
+                with lock:
+                    refused[0] += 1
+
+    threads = [threading.Thread(target=worker, args=(i % 2,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert children[0].used_bytes == accepted[0]
+    assert children[1].used_bytes == accepted[1]
+    assert parent.used_bytes == accepted[0] + accepted[1]
+    # 8 threads x 200 x 100b = 160k attempted >> 50k parent limit
+    assert refused[0] > 0
+    assert parent.used_bytes <= parent.limit_bytes
+    total_trips = (parent.trip_count + children[0].trip_count
+                   + children[1].trip_count)
+    assert total_trips == refused[0]
+    for ci in range(2):
+        children[ci].release(accepted[ci])
+    assert parent.used_bytes == 0
