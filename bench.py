@@ -892,8 +892,74 @@ def dryrun_faults() -> int:
     return 0 if ok else 1
 
 
+def dryrun_disruption() -> int:
+    """Failover dry-run (PR 6): form the in-process 4-node cluster, fault
+    one data node's query RPC, and assert the search STILL completes with
+    results bit-identical to the fault-free run (`_shards.failed == 0`,
+    `shard_retries > 0`); then fault EVERY copy and assert a partial with
+    populated `_shards.failures`. One JSON line on stdout; exit 0/1."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.action.search_action import coordinator_stats
+    from elasticsearch_tpu.cluster_node import form_local_cluster
+    from elasticsearch_tpu.common import faults
+
+    log("dryrun_disruption: forming 4-node cluster...")
+    nodes, store, channels = form_local_cluster(
+        ["m0", "d0", "d1", "d2"], roles={"m0": ("master",)})
+    master, a = nodes[0], nodes[1]
+    a.create_index("docs", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+        "mappings": {"properties": {"n": {"type": "integer"},
+                                    "body": {"type": "text"}}}})
+    a.bulk("docs", [{"op": "index", "id": str(i),
+                     "source": {"n": i, "body": f"word{i % 7} common text"}}
+                    for i in range(60)])
+    a.refresh("docs")
+
+    body = {"query": {"match": {"body": "common"}}, "size": 10,
+            "track_total_hits": True}
+    clean = master.search("docs", body)
+    clean.pop("took", None)
+
+    copies = [r for r in store.current().shard_copies("docs", 0)
+              if r.state == "STARTED"]
+    victim = master.search_action._rank_copies(copies)[0]
+    before = dict(coordinator_stats())
+    with faults.inject(f"rpc_query#{victim}:raisexinf"):
+        failed_over = master.search("docs", body)
+    failed_over.pop("took", None)
+    after = coordinator_stats()
+    retries = after["shard_retries"] - before["shard_retries"]
+
+    with faults.inject("rpc_query:raisexinf"):
+        partial = master.search("docs", body)
+
+    identical = failed_over == clean
+    ok = (identical and failed_over["_shards"]["failed"] == 0
+          and retries >= 1
+          and partial["_shards"]["failed"] == partial["_shards"]["total"]
+          and bool(partial["_shards"].get("failures")))
+    print(json.dumps({
+        "metric": "dryrun_disruption",
+        "ok": bool(ok),
+        "identical_under_failover": bool(identical),
+        "failed_over_shards_failed": int(failed_over["_shards"]["failed"]),
+        "shard_retries": int(retries),
+        "all_down_failed": int(partial["_shards"]["failed"]),
+        "all_down_failures": len(partial["_shards"].get("failures", [])),
+    }), flush=True)
+    log(f"dryrun_disruption: identical={identical} retries={retries}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
         sys.exit(dryrun_faults())
+    if "dryrun_disruption" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_disruption":
+        sys.exit(dryrun_disruption())
     main()

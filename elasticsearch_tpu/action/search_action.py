@@ -19,16 +19,30 @@ action/search/QueryPhaseResultConsumer.java:52,96): shard results reduce
 incrementally every `batched_reduce_size` arrivals — hit windows truncate
 to from+size and aggregation partials fold into one — with the pending
 partials' byte estimate reserved on the coordinator's request breaker.
+
+Shard FAILOVER (ref: AbstractSearchAsyncAction.onShardFailure ->
+performPhaseOnShard(nextShard)): a shard-query failure retries the shard on
+the next-best STARTED copy — excluded-node tracking, bounded by
+``ES_TPU_SEARCH_SHARD_RETRIES`` — and the shard only counts failed when
+every copy is exhausted, with per-shard reasons in `_shards.failures`.
+Consecutive transport failures to a node open a `NodeTransportHealth`
+circuit (common/health.py) that replica routing skips; the request
+`timeout` travels in the shard payload and bounds each RPC
+(``ES_TPU_RPC_TIMEOUT_MS`` floor) so a hung node yields `timed_out: true`
+partials at the coordinator instead of wedging the pool.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common.errors import (
     CircuitBreakingError, ElasticsearchTpuError, IndexNotFoundError,
+    SearchPhaseExecutionError,
 )
 from elasticsearch_tpu.cluster.state import ClusterState
 from elasticsearch_tpu.indices.shard_service import DistributedShardService
@@ -37,7 +51,9 @@ from elasticsearch_tpu.search.query_phase import (
     QuerySearchResult, ShardHit, _sort_key, execute_query_phase, parse_sort,
 )
 from elasticsearch_tpu.search.reader_context import ReaderContextRegistry
-from elasticsearch_tpu.transport.channels import NodeChannels
+from elasticsearch_tpu.transport.channels import (
+    NodeChannels, NodeUnavailableError, RpcTimeoutError,
+)
 from elasticsearch_tpu.transport.service import TransportService
 
 ACTION_QUERY = "indices:data/read/search[phase/query]"
@@ -45,6 +61,57 @@ ACTION_FETCH = "indices:data/read/search[phase/fetch/id]"
 ACTION_FREE = "indices:data/read/search[free_context]"
 ACTION_CAN_MATCH = "indices:data/read/search[can_match]"
 _PRE_FILTER_SHARD_SIZE = 4   # ref default is 128; our meshes are smaller
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+# ---- coordinator resilience counters (node-wide; `tpu_coordinator`
+#      section of GET /_nodes/stats) ----
+
+_COORD_LOCK = threading.Lock()
+_COORD_COUNTERS: Dict[str, int] = {
+    "shard_retries": 0,        # failover attempts on a next-best copy
+    "node_circuit_open": 0,    # candidates skipped on an open node circuit
+    "rpc_timeouts": 0,         # RPCs abandoned past their deadline
+    "fetch_failures": 0,       # shards dropped in the fetch phase
+    "can_match_reroutes": 0,   # pre-filter targets demoted as unreachable
+    "deadline_expired": 0,     # shards not attempted: request deadline hit
+}
+
+
+def _count_coord(key: str, n: int = 1) -> None:
+    with _COORD_LOCK:
+        _COORD_COUNTERS[key] += n
+
+
+def coordinator_stats() -> dict:
+    """`tpu_coordinator` stats: resilience counters + transport circuits."""
+    from elasticsearch_tpu.common.health import node_transport_health_stats
+
+    with _COORD_LOCK:
+        out: dict = dict(_COORD_COUNTERS)
+    out["transport"] = node_transport_health_stats()
+    return out
+
+
+def _is_transport_error(e: BaseException) -> bool:
+    """Transport-level failures feed the node circuit; application errors
+    from a reachable node (parse errors, missing shard) do not."""
+    return isinstance(e, (NodeUnavailableError, RpcTimeoutError))
+
+
+@dataclasses.dataclass
+class _ShardTarget:
+    """One shard to query, with its failover candidates in routing order."""
+
+    index: str
+    sid: int
+    candidates: List[str]      # STARTED copy holders, best first
 
 
 def _py(v):
@@ -235,6 +302,10 @@ class SearchActionService:
         # service time (ref: OperationRouting.java:34 rankShardsAndUpdateStats
         # / ResponseCollectorService)
         self._node_ewma_ms: Dict[str, float] = {}
+        # per-target-node transport circuits (common/health.py): consecutive
+        # transport failures quarantine the node from replica routing until
+        # a half-open probe readmits it
+        self._node_health: Dict[str, "NodeTransportHealth"] = {}
 
     # ---------------- shard-level handlers (data node) ----------------
 
@@ -302,7 +373,8 @@ class SearchActionService:
         return {"total": qr.total, "relation": qr.relation,
                 "max_score": _py(qr.max_score), "hits": hits_wire,
                 "context_id": ctx.context_id, "aggs": aggs_wire,
-                "suggest": suggest_out, "profile": qr.profile}
+                "suggest": suggest_out, "profile": qr.profile,
+                "timed_out": bool(getattr(qr, "timed_out", False))}
 
     def _on_shard_fetch(self, req) -> dict:
         p = req.payload
@@ -374,20 +446,226 @@ class SearchActionService:
             try:
                 self.channels.request(
                     r["_node"], ACTION_FREE,
-                    {"context_id": r["context_id"]})
+                    {"context_id": r["context_id"]},
+                    source=self.shards.node_name)
             except Exception:  # noqa: BLE001 — reaper collects leftovers
                 pass
 
+    # ---- failover plumbing ----
+
+    def _node_circuit(self, node: str):
+        h = self._node_health.get(node)
+        if h is None:
+            from elasticsearch_tpu.common.health import NodeTransportHealth
+
+            h = NodeTransportHealth(f"{self.shards.node_name}->{node}")
+            self._node_health[node] = h
+        return h
+
+    def _record_transport_outcome(self, node: str,
+                                  err: Optional[BaseException] = None) -> None:
+        """Feed the node circuit: transport failures count against it; a
+        REACHABLE node answering with an application error proves the
+        transport edge healthy (and completes any half-open probe)."""
+        h = self._node_circuit(node)
+        if err is None or not _is_transport_error(err):
+            h.record_success()
+        else:
+            h.record_fault(err)
+
+    def _penalize_node(self, node: str) -> None:
+        # penalize the node so ARS stops preferring a failing copy
+        prev = self._node_ewma_ms.get(node, 0.0)
+        self._node_ewma_ms[node] = 0.7 * prev + 0.3 * 5000.0
+
+    def _note_node_ok(self, node: str, took_ms: float) -> None:
+        prev = self._node_ewma_ms.get(node, took_ms)
+        self._node_ewma_ms[node] = 0.7 * prev + 0.3 * took_ms
+        # age every OTHER node's stat toward zero so a once-bad node is
+        # retried eventually (ref: ResponseCollectorService adjusts stats
+        # for unselected nodes)
+        for other in self._node_ewma_ms:
+            if other != node:
+                self._node_ewma_ms[other] *= 0.98
+
+    def _rank_copies(self, copies) -> List[str]:
+        """Replica-selection order for one shard's STARTED copies: the
+        local copy is free; remote copies rank by service-time EWMA (ref:
+        OperationRouting.java:34); quarantined nodes (open transport
+        circuit) sink to last resort."""
+        from elasticsearch_tpu.common.health import CLOSED
+
+        def key(r):
+            h = self._node_health.get(r.node_id)
+            quarantined = 1 if h is not None and h.state != CLOSED else 0
+            local = 0 if r.node_id == self.shards.node_name else 1
+            return (quarantined, local,
+                    self._node_ewma_ms.get(r.node_id, 0.0), r.node_id)
+
+        return [r.node_id for r in sorted(copies, key=key)]
+
+    @staticmethod
+    def _failure_entry(index: str, sid: int, node: Optional[str],
+                       err: BaseException, phase: str,
+                       attempted: Optional[List[str]] = None) -> dict:
+        reason = {"type": getattr(err, "error_type", type(err).__name__),
+                  "reason": str(err), "phase": phase}
+        if attempted:
+            reason["attempted_nodes"] = list(attempted)
+        return {"shard": sid, "index": index, "node": node,
+                "status": "failed", "reason": reason}
+
+    @staticmethod
+    def _shard_body(body: dict, deadline) -> dict:
+        """Deadline propagation: the shard query carries the REMAINING
+        request budget, so the data node's own dispatch deadline shrinks as
+        coordinator time is spent."""
+        if deadline is None:
+            return body
+        rem = deadline.remaining_ms()
+        shard_body = dict(body)
+        shard_body["timeout"] = max(1, int(rem if rem is not None else 1))
+        return shard_body
+
+    def _rpc(self, node: str, action: str, payload: dict,
+             deadline=None) -> dict:
+        """One bounded RPC. The bound is the request deadline's remaining
+        budget, floored at ``ES_TPU_RPC_TIMEOUT_MS`` (so a nearly-spent
+        budget still gives the RPC a useful window); with no deadline the
+        floor alone applies when set. Unbounded calls dispatch directly —
+        no thread hop on the common path. A hung RPC is abandoned at the
+        bound (`RpcTimeoutError`); its worker thread dies with the late
+        reply instead of wedging a pool worker."""
+        floor_ms = float(_env_int("ES_TPU_RPC_TIMEOUT_MS", 0))
+        timeout_ms: Optional[float] = None
+        if deadline is not None:
+            rem = deadline.remaining_ms()
+            if rem is not None and rem <= 0:
+                raise RpcTimeoutError(
+                    f"request deadline expired before [{action}] "
+                    f"to [{node}]")
+            if rem is not None:
+                timeout_ms = max(rem, floor_ms)
+            elif floor_ms > 0:
+                timeout_ms = floor_ms
+        elif floor_ms > 0:
+            timeout_ms = floor_ms
+        src = self.shards.node_name
+        if timeout_ms is None:
+            return self.channels.request(node, action, payload, source=src)
+        box: dict = {}
+
+        def run():
+            try:
+                box["r"] = self.channels.request(node, action, payload,
+                                                 source=src)
+            except BaseException as e:  # noqa: BLE001 — crosses the thread
+                box["e"] = e
+
+        t = threading.Thread(target=run, daemon=True, name=f"rpc[{node}]")
+        t.start()
+        t.join(timeout_ms / 1000.0)
+        if t.is_alive():
+            _count_coord("rpc_timeouts")
+            raise RpcTimeoutError(
+                f"[{action}] to [{node}] timed out after {timeout_ms:.0f}ms")
+        if "e" in box:
+            raise box["e"]
+        return box["r"]
+
+    def _query_shard_with_failover(self, target: _ShardTarget, body: dict,
+                                   deadline, retries_max: int):
+        """Query one shard, failing over to the next-best STARTED copy
+        (ref: AbstractSearchAsyncAction.onShardFailure ->
+        performPhaseOnShard(nextShard)). Attempted nodes are excluded from
+        re-selection; open-circuit nodes are skipped unless every copy is
+        quarantined (then the best one gets a forced probe). Returns
+        (response, None) on success, (None, failure_entry) when the copies
+        are exhausted."""
+        attempted: List[str] = []
+        quarantined: List[str] = []
+        last_err: Optional[BaseException] = None
+        budget = retries_max + 1
+
+        def attempt(node: str):
+            nonlocal last_err
+            if attempted:
+                _count_coord("shard_retries")
+            attempted.append(node)
+            t_q = time.monotonic()
+            try:
+                resp = self._rpc(
+                    node, ACTION_QUERY,
+                    {"index": target.index, "shard_id": target.sid,
+                     "body": self._shard_body(body, deadline)}, deadline)
+            except CircuitBreakingError:
+                # a breaker trip is a REQUEST error, not a shard failure —
+                # swallowing it would return silently-wrong aggregations
+                # under memory pressure
+                raise
+            except Exception as e:  # noqa: BLE001 — failover candidate
+                last_err = e
+                self._penalize_node(node)
+                self._record_transport_outcome(node, e)
+                return None
+            self._record_transport_outcome(node)
+            self._note_node_ok(node, (time.monotonic() - t_q) * 1000.0)
+            resp["_node"] = node
+            resp["_index"] = target.index
+            resp["_shard"] = target.sid
+            return resp
+
+        for node in target.candidates:
+            if len(attempted) >= budget:
+                break
+            if deadline is not None and deadline.expired:
+                break
+            h = self._node_health.get(node)
+            if h is not None and not h.allow_request():
+                _count_coord("node_circuit_open")
+                quarantined.append(node)
+                continue
+            resp = attempt(node)
+            if resp is not None:
+                return resp, None
+        if not attempted and quarantined \
+                and not (deadline is not None and deadline.expired):
+            # every copy quarantined: one forced probe beats failing the
+            # shard with zero attempts
+            resp = attempt(quarantined[0])
+            if resp is not None:
+                return resp, None
+        if last_err is None:
+            last_err = RpcTimeoutError(
+                "request timeout expired before the shard query could run")
+        node = attempted[-1] if attempted else \
+            (quarantined[-1] if quarantined else None)
+        return None, self._failure_entry(target.index, target.sid, node,
+                                         last_err, "query",
+                                         attempted=attempted)
+
     def execute_search(self, index_expr: str, body: dict,
                        state: Optional[ClusterState] = None) -> dict:
-        """query_then_fetch across every target shard's best copy."""
+        """query_then_fetch across every target shard's best copy, with
+        replica failover, deadline propagation, and partial-results
+        accounting (see module docstring)."""
+        from elasticsearch_tpu.tasks.task_manager import (
+            Deadline, parse_timeout_ms,
+        )
+
         start = time.monotonic()
         state = state or self.shards.state
         indices = state.resolve_indices(index_expr)
         if not indices:
             raise IndexNotFoundError(index_expr)
 
-        targets: List[Tuple[str, str, int]] = []   # (node, index, shard_id)
+        timeout_ms = parse_timeout_ms(body.get("timeout"))
+        deadline = Deadline(timeout_ms) if timeout_ms is not None else None
+        allow_partial = \
+            body.get("allow_partial_search_results", True) is not False
+        retries_max = max(0, _env_int("ES_TPU_SEARCH_SHARD_RETRIES", 3))
+
+        targets: List[_ShardTarget] = []
         for index in indices:
             meta = state.indices[index]
             if meta.state == "close":
@@ -401,19 +679,8 @@ class SearchActionService:
                     raise ElasticsearchTpuError(
                         f"all shards failed: no started copy of "
                         f"[{index}][{sid}]")
-                # adaptive replica selection: the local copy is free; among
-                # remote copies, prefer the node with the best observed
-                # service-time EWMA (ref: OperationRouting.java:34)
-                local = next((r for r in copies
-                              if r.node_id == self.shards.node_name), None)
-                if local is not None:
-                    chosen = local
-                else:
-                    chosen = min(
-                        copies,
-                        key=lambda r: (self._node_ewma_ms.get(
-                            r.node_id, 0.0), r.node_id))
-                targets.append((chosen.node_id, index, sid))
+                targets.append(
+                    _ShardTarget(index, sid, self._rank_copies(copies)))
 
         size = int(body.get("size", 10))
         from_ = int(body.get("from", 0))
@@ -429,66 +696,133 @@ class SearchActionService:
             if len(targets) >= _PRE_FILTER_SHARD_SIZE else []
         if required:
             kept = []
-            for node, index, sid in targets:
+            for t in targets:
+                node = t.candidates[0]
                 try:
-                    r = self.channels.request(
-                        node, ACTION_CAN_MATCH,
-                        {"index": index, "shard_id": sid,
-                         "required_terms": required})
+                    r = self._rpc(node, ACTION_CAN_MATCH,
+                                  {"index": t.index, "shard_id": t.sid,
+                                   "required_terms": required}, deadline)
+                    self._record_transport_outcome(node)
                     if r.get("can_match", True):
-                        kept.append((node, index, sid))
+                        kept.append(t)
                     else:
                         skipped += 1
-                except Exception:  # noqa: BLE001 — fail open
-                    kept.append((node, index, sid))
+                except Exception as e:  # noqa: BLE001 — fail OPEN, but
+                    # re-route: the unreachable node must not stay the
+                    # query-phase target, so demote it to last resort and
+                    # penalize its EWMA before the fan-out
+                    self._penalize_node(node)
+                    self._record_transport_outcome(node, e)
+                    if len(t.candidates) > 1:
+                        t.candidates = t.candidates[1:] + [node]
+                    _count_coord("can_match_reroutes")
+                    kept.append(t)
             targets = kept
 
         consumer = _QueryPhaseResultConsumer(
             body, sort, k=from_ + size,
             breaker=self.breakers.get_breaker("request"))
         shard_results: List[dict] = []
+        failures: List[dict] = []
         failed = 0
+        timed_out = False
+        fetch_failed: set = set()
+        fetched: Dict[Tuple[int, int], dict] = {}  # (shard_idx, pos) -> hit
         try:
-            for node, index, sid in targets:
-                t_q = time.monotonic()
-                try:
-                    resp = self.channels.request(
-                        node, ACTION_QUERY,
-                        {"index": index, "shard_id": sid, "body": body})
-                    resp["_node"] = node
-                    resp["_index"] = index
-                    resp["_shard"] = sid
-                    shard_results.append(resp)
-                    consumer.consume(len(shard_results) - 1, resp)
-                    # the consumer owns hit windows + agg partials from here;
-                    # drop them from the retained metadata so coordinator
-                    # memory stays bounded by the batch size
-                    resp["hits"] = ()
-                    resp["aggs"] = None
-                    took_ms = (time.monotonic() - t_q) * 1000.0
-                    prev = self._node_ewma_ms.get(node, took_ms)
-                    self._node_ewma_ms[node] = 0.7 * prev + 0.3 * took_ms
-                    # age every OTHER node's stat toward zero so a once-bad
-                    # node is retried eventually (ref: ResponseCollectorService
-                    # adjusts stats for unselected nodes)
-                    for other in self._node_ewma_ms:
-                        if other != node:
-                            self._node_ewma_ms[other] *= 0.98
-                except CircuitBreakingError:
-                    # a coordinator-side breaker trip is a REQUEST error, not
-                    # a shard failure — swallowing it would return
-                    # silently-wrong aggregations under memory pressure
-                    raise
-                except Exception:  # noqa: BLE001
+            for t in targets:
+                if deadline is not None and deadline.expired:
+                    # budget exhausted mid-fan-out: remaining shards become
+                    # timed-out partials, not an error (unless strict)
+                    timed_out = True
+                    _count_coord("deadline_expired")
                     failed += 1
-                    # penalize the node so ARS stops preferring a failing copy
-                    prev = self._node_ewma_ms.get(node, 0.0)
-                    self._node_ewma_ms[node] = 0.7 * prev + 0.3 * 5000.0
+                    failures.append(self._failure_entry(
+                        t.index, t.sid, None, RpcTimeoutError(
+                            "request timeout expired before the shard "
+                            "query could run"), "query"))
+                    continue
+                resp, failure = self._query_shard_with_failover(
+                    t, body, deadline, retries_max)
+                if resp is None:
+                    failed += 1
+                    failures.append(failure)
+                    if failure["reason"]["type"] == \
+                            "receive_timeout_transport_exception":
+                        timed_out = True
+                    continue
+                if resp.get("timed_out"):
+                    timed_out = True
+                shard_results.append(resp)
+                consumer.consume(len(shard_results) - 1, resp)
+                # the consumer owns hit windows + agg partials from here;
+                # drop them from the retained metadata so coordinator
+                # memory stays bounded by the batch size
+                resp["hits"] = ()
+                resp["aggs"] = None
+
+            if not allow_partial and failed:
+                raise SearchPhaseExecutionError(
+                    f"{failed} of {len(targets)} shards failed and "
+                    f"allow_partial_search_results=false: "
+                    f"{failures[0]['reason']['reason']}",
+                    failures=failures)
 
             # ---- reduce (ref: SearchPhaseController.reducedQueryPhase) ----
             # the incremental consumer already merged/deduped/truncated as
             # results arrived; finish() folds any remainder
             window_entries, agg_state = consumer.finish()
+
+            window = [(si, h, shard_results[si])
+                      for si, h in window_entries][from_: from_ + size]
+
+            # ---- fetch winning docs from their owning shards (per-shard
+            # isolation: ONE failed fetch drops that shard's hits and gets
+            # accounted in _shards.failures; the rest of the response — and
+            # every reader context — survives) ----
+            by_shard: Dict[int, List[dict]] = {}
+            for si, h, r in window:
+                by_shard.setdefault(si, []).append(h)
+            for si, hits in by_shard.items():
+                r = shard_results[si]
+                node = r["_node"]
+                if deadline is not None and deadline.expired:
+                    timed_out = True
+                    _count_coord("deadline_expired")
+                    fetch_failed.add(si)
+                    failures.append(self._failure_entry(
+                        r["_index"], r["_shard"], node, RpcTimeoutError(
+                            "request timeout expired before the fetch "
+                            "phase"), "fetch"))
+                    continue
+                try:
+                    resp = self._rpc(
+                        node, ACTION_FETCH,
+                        {"context_id": r["context_id"], "hits": hits,
+                         "body": body}, deadline)
+                    self._record_transport_outcome(node)
+                except CircuitBreakingError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — drop one shard
+                    _count_coord("fetch_failures")
+                    self._penalize_node(node)
+                    self._record_transport_outcome(node, e)
+                    fetch_failed.add(si)
+                    failures.append(self._failure_entry(
+                        r["_index"], r["_shard"], node, e, "fetch"))
+                    if _is_transport_error(e) and \
+                            isinstance(e, RpcTimeoutError):
+                        timed_out = True
+                    continue
+                for h, out in zip(hits, resp["hits"]):
+                    fetched[(si, h["global_ord"], h["leaf_idx"])] = out
+
+            if not allow_partial and (fetch_failed or timed_out):
+                reason = (failures[0]["reason"]["reason"] if failures
+                          else "request timed out")
+                raise SearchPhaseExecutionError(
+                    f"partial results with "
+                    f"allow_partial_search_results=false: {reason}",
+                    failures=failures)
         except BaseException:
             # breaker trip (or any coordinator error) mid-request: the
             # consumer's pending agg reservation and every reader context
@@ -501,8 +835,6 @@ class SearchActionService:
         total = consumer.total
         relation = consumer.relation
         collapse_field = consumer.collapse
-        window = [(si, h, shard_results[si])
-                  for si, h in window_entries][from_: from_ + size]
 
         max_score = None
         if not sort:
@@ -510,19 +842,6 @@ class SearchActionService:
                   if r["max_score"] is not None]
             if ms:
                 max_score = max(ms)
-
-        # ---- fetch winning docs from their owning shards ----
-        by_shard: Dict[int, List[dict]] = {}
-        for si, h, r in window:
-            by_shard.setdefault(si, []).append(h)
-        fetched: Dict[Tuple[int, int], dict] = {}  # (shard_idx, pos) -> hit
-        for si, hits in by_shard.items():
-            r = shard_results[si]
-            resp = self.channels.request(
-                r["_node"], ACTION_FETCH,
-                {"context_id": r["context_id"], "hits": hits, "body": body})
-            for h, out in zip(hits, resp["hits"]):
-                fetched[(si, h["global_ord"], h["leaf_idx"])] = out
 
         hits_out = []
         for si, h, r in window:
@@ -563,12 +882,24 @@ class SearchActionService:
                  "searches": [{"query": r.get("profile") or [],
                                "rewrite_time": 0, "collector": []}]}
                 for r in shard_results]}
+        if deadline is not None and deadline.expired:
+            timed_out = True
+        shards_section = {
+            "total": len(targets) + skipped,
+            "successful": len(shard_results) - len(fetch_failed) + skipped,
+            "skipped": skipped,
+            "failed": failed + len(fetch_failed),
+        }
+        if failures:
+            # per-shard reasons — only for shards whose copies were
+            # EXHAUSTED (or whose fetch failed); recovered failovers leave
+            # no trace here, keeping failed-over responses bit-identical to
+            # fault-free ones
+            shards_section["failures"] = failures
         resp = {
             "took": int((time.monotonic() - start) * 1000),
-            "timed_out": False,
-            "_shards": {"total": len(targets) + skipped,
-                        "successful": len(shard_results) + skipped,
-                        "skipped": skipped, "failed": failed},
+            "timed_out": bool(timed_out),
+            "_shards": shards_section,
             "hits": {"total": {"value": total, "relation": relation},
                      "max_score": max_score, "hits": hits_out},
         }

@@ -11,9 +11,11 @@ Spec grammar (';'-separated clauses)::
 
     site[#part]:mode[@nth][xcount][=arg][~prob]
 
-      site   one of KNOWN_SITES (turbo_sweep, fused_dispatch, merge_kernel,
-             column_upload, blockmax_pass)
-      #part  restrict to one partition id (default: any)
+      site   one of KNOWN_SITES: device dispatch sites (turbo_sweep,
+             fused_dispatch, merge_kernel, column_upload, blockmax_pass) or
+             transport RPC sites (rpc_query, rpc_fetch, rpc_can_match)
+      #part  restrict to one partition id — or, for transport sites, to one
+             TARGET NODE by name (``rpc_query#d1``); default: any
       mode   raise | oom | hang
       @nth   1-based call number at which the fault first fires (default 1)
       xcount how many consecutive calls fire ('inf' = forever; default 1)
@@ -41,13 +43,19 @@ from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import DeviceFaultError, HbmOomError
 
+TRANSPORT_SITES = frozenset({
+    "rpc_query",         # coordinator -> data node shard query RPC
+    "rpc_fetch",         # coordinator -> data node fetch-by-id RPC
+    "rpc_can_match",     # coordinator -> data node can_match pre-filter RPC
+})
+
 KNOWN_SITES = frozenset({
     "turbo_sweep",       # TurboBM25 device sweep (disjunctive + bool)
     "fused_dispatch",    # ShardedTurbo fused S>1 shard_map dispatch
     "merge_kernel",      # device-side partition top-k merge
     "column_upload",     # int8 column build/refresh onto the device
     "blockmax_pass",     # BlockMax engine device pass
-})
+}) | TRANSPORT_SITES
 
 _MODES = frozenset({"raise", "oom", "hang"})
 
@@ -68,7 +76,7 @@ class FaultSpecError(ValueError):
 @dataclass
 class _Clause:
     site: str
-    part: Optional[int]
+    part: Optional[Any]       # partition id (int) or target node name (str)
     mode: str
     nth: int = 1
     count: float = 1          # float so 'inf' works
@@ -78,10 +86,11 @@ class _Clause:
     fired: int = 0
     rng: Optional[random.Random] = None
 
-    def matches(self, site: str, part: Optional[int]) -> bool:
+    def matches(self, site: str, part: Optional[Any]) -> bool:
         if site != self.site:
             return False
-        if self.part is not None and part != self.part:
+        if self.part is not None and part != self.part \
+                and str(part) != str(self.part):
             return False
         return True
 
@@ -125,17 +134,27 @@ def parse_spec(spec: str) -> List[_Clause]:
         if ":" not in raw:
             raise FaultSpecError(f"fault clause missing ':': {raw!r}")
         head, tail = raw.split(":", 1)
-        part: Optional[int] = None
+        part_str: Optional[str] = None
         if "#" in head:
-            head, p = head.split("#", 1)
-            try:
-                part = int(p)
-            except ValueError:
+            head, part_str = head.split("#", 1)
+            if not part_str:
                 raise FaultSpecError(f"bad partition in clause {raw!r}")
         site = head.strip()
         if site not in KNOWN_SITES:
             raise FaultSpecError(
                 f"unknown fault site {site!r}; known: {sorted(KNOWN_SITES)}")
+        part: Optional[Any] = None
+        if part_str is not None:
+            try:
+                part = int(part_str)
+            except ValueError:
+                # transport sites select by target node NAME; device sites
+                # still require an integer partition id
+                if site in TRANSPORT_SITES:
+                    part = part_str
+                else:
+                    raise FaultSpecError(
+                        f"bad partition in clause {raw!r}")
         c = _Clause(site=site, part=part, mode="")
         # peel ~prob, =arg, xcount, @nth off the tail (order-independent
         # parse: split on each marker from the right)
@@ -193,28 +212,34 @@ def inject(spec: str):
             _ACTIVE = prev
 
 
-def fault_point(site: str, part: Optional[int] = None) -> None:
-    """Named dispatch site: raises/oom/hangs when an active clause fires.
+def _fire_mode(site: str, part: Optional[Any]) -> Optional[tuple]:
+    """(mode, arg) when an active clause fires for this call, else None.
 
     The module-level `_ACTIVE is None` check keeps the no-faults fast path
     to a single attribute load."""
     active = _ACTIVE
     if active is None:
-        return
+        return None
     with _LOCK:
         if _ACTIVE is not active:     # swapped under us; re-read
             active = _ACTIVE
             if active is None:
-                return
+                return None
         for c in active:
             if not c.matches(site, part):
                 continue
             if not c.should_fire():
                 continue
-            mode, arg = c.mode, c.arg
-            break
-        else:
-            return
+            return c.mode, c.arg
+    return None
+
+
+def fault_point(site: str, part: Optional[int] = None) -> None:
+    """Named dispatch site: raises/oom/hangs when an active clause fires."""
+    hit = _fire_mode(site, part)
+    if hit is None:
+        return
+    mode, arg = hit
     if mode == "hang":
         # Sleep past the deadline, then return normally: the dispatch
         # "completes" late and the Deadline check upstream times it out.
@@ -229,6 +254,26 @@ def fault_point(site: str, part: Optional[int] = None) -> None:
         f"injected device fault at {site}"
         + (f"#{part}" if part is not None else ""),
         site=site, part=part)
+
+
+def transport_fault_point(site: str, node: str) -> None:
+    """Named transport RPC site (coordinator -> `node`): raises
+    `NodeUnavailableError` — the SAME exception an organic dead/partitioned
+    node produces, so injected and organic transport faults take identical
+    recovery paths through the coordinator — or hangs past the RPC deadline
+    (the reply "arrives" after the coordinator stopped waiting)."""
+    hit = _fire_mode(site, node)
+    if hit is None:
+        return
+    mode, arg = hit
+    if mode == "hang":
+        time.sleep(arg)
+        return
+    # raise and oom both model an unreachable node on a transport site
+    from elasticsearch_tpu.transport.channels import NodeUnavailableError
+
+    raise NodeUnavailableError(
+        f"injected transport fault at {site}#{node}")
 
 
 def is_device_error(e: BaseException) -> bool:

@@ -47,7 +47,14 @@ _NODE_TOTALS: Dict[str, int] = {k: 0 for k in _COUNTERS}
 
 
 class EngineHealth:
-    """Thread-safe dispatch circuit breaker for one engine."""
+    """Thread-safe dispatch circuit breaker for one engine.
+
+    Subclasses repoint `_REG`/`_TOTALS` to keep a separate population (the
+    coordinator's per-node transport circuits must not pollute the
+    device-health `tpu_health` section)."""
+
+    _REG = _REGISTRY
+    _TOTALS = _NODE_TOTALS
 
     def __init__(self, name: str, trip_n: Optional[int] = None,
                  backoff_ms: Optional[int] = None):
@@ -66,7 +73,7 @@ class EngineHealth:
         self.counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
         self._transitions: collections.deque = collections.deque(maxlen=16)
         self.last_fault: Optional[str] = None
-        _REGISTRY.add(self)
+        self._REG.add(self)
 
     # ---- state machine ----
 
@@ -131,7 +138,7 @@ class EngineHealth:
     def _bump(self, key: str, n: int = 1) -> None:
         self.counters[key] += n
         with _NODE_LOCK:
-            _NODE_TOTALS[key] += n
+            self._TOTALS[key] += n
 
     # ---- reporting ----
 
@@ -164,4 +171,45 @@ def node_health_stats() -> dict:
         "engines": [dict(e.stats(), name=e.name) for e in engines],
         "open_circuits": sum(1 for e in engines if e.state != CLOSED),
         **totals,
+    }
+
+
+# ---- coordinator-side transport circuits (PR 6) ----
+#
+# The SAME three-state machine guards the distributed rung of the fault
+# ladder: consecutive transport failures to a target node open a circuit
+# that replica routing skips (quarantine), then a half-open probe decides
+# whether the node ages back in — instead of ARS slowly decaying a dead
+# node's EWMA until it gets retried.
+
+_TRANSPORT_REGISTRY: "weakref.WeakSet[EngineHealth]" = weakref.WeakSet()
+_TRANSPORT_TOTALS: Dict[str, int] = {k: 0 for k in _COUNTERS}
+
+
+class NodeTransportHealth(EngineHealth):
+    """Circuit for one coordinator->node transport edge. `device_faults`
+    counts TRANSPORT failures here (the machine is shared; the registry is
+    not, so `tpu_health` never mixes the two populations)."""
+
+    _REG = _TRANSPORT_REGISTRY
+    _TOTALS = _TRANSPORT_TOTALS
+
+    # transport-flavored aliases over the shared state machine
+    allow_request = EngineHealth.allow_device
+
+
+def node_transport_health_stats() -> dict:
+    """Coordinator transport-circuit summary for the ``tpu_coordinator``
+    section of GET /_nodes/stats."""
+    circuits = sorted(_TRANSPORT_REGISTRY, key=lambda h: h.name)
+    with _NODE_LOCK:
+        totals = dict(_TRANSPORT_TOTALS)
+    return {
+        "nodes": [dict(c.stats(), name=c.name) for c in circuits],
+        "open_circuits": sum(1 for c in circuits if c.state != CLOSED),
+        "transport_failures": totals["device_faults"],
+        "circuit_opens": totals["circuit_opens"],
+        "circuit_reopens": totals["circuit_reopens"],
+        "probes": totals["probes"],
+        "probe_successes": totals["probe_successes"],
     }

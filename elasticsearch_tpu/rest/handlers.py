@@ -1902,6 +1902,7 @@ class _Handlers:
                 "tpu_coalescer": _default_coalescer_stats(),
                 "tpu_turbo": _turbo_merge_stats(),
                 "tpu_health": _tpu_health_stats(),
+                "tpu_coordinator": _tpu_coordinator_stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
         })
@@ -2215,6 +2216,15 @@ def _tpu_health_stats() -> dict:
     out["coalesce_batch_retries"] = \
         default_coalescer().stats()["coalesce_batch_retries"]
     return out
+
+
+def _tpu_coordinator_stats() -> dict:
+    """Coordinator resilience section (PR 6): shard failover retries, open
+    node-transport circuits, abandoned RPCs, fetch-phase drops, plus the
+    per-edge transport circuit states."""
+    from elasticsearch_tpu.action.search_action import coordinator_stats
+
+    return coordinator_stats()
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

@@ -192,3 +192,11 @@ class Deadline:
             self.timed_out = True
             return True
         return False
+
+    def remaining_ms(self) -> Optional[float]:
+        """Milliseconds until expiry (negative when past), None when
+        unbounded — lets a coordinator size per-RPC timeouts from the
+        request budget."""
+        if self._deadline is None:
+            return None
+        return (self._deadline - time.monotonic()) * 1000.0

@@ -5,13 +5,38 @@ test/disruption/DisruptableMockTransport.java: message delivery is a
 scheduled task with configurable delay, and a rule table can blackhole or
 delay traffic between node pairs to simulate partitions — two-sided,
 bridge, or isolate-one.
+
+Failure taxonomy: a dropped delivery surfaces through `on_error` as the
+SAME `NodeUnavailableError` the transport layer raises for killed or
+partitioned nodes (transport/channels.py) — so coordination code exercises
+the identical recovery path here as under live fault injection. Legacy
+zero-arg `on_error` callbacks keep working; callbacks that accept one
+argument receive the error.
 """
 
 from __future__ import annotations
 
+import inspect
 from typing import Callable, Dict, Optional, Set, Tuple
 
 from elasticsearch_tpu.testing.deterministic import DeterministicTaskQueue
+
+
+def _invoke_on_error(on_error: Callable, sender: str, to: str) -> None:
+    """Call `on_error`, passing a `NodeUnavailableError` when the callback
+    accepts an argument (new taxonomy) and nothing when it doesn't (legacy
+    zero-arg callbacks, e.g. cluster/coordination.py's lambdas)."""
+    try:
+        accepts_arg = bool(inspect.signature(on_error).parameters)
+    except (TypeError, ValueError):
+        accepts_arg = False
+    if accepts_arg:
+        from elasticsearch_tpu.transport.channels import NodeUnavailableError
+
+        on_error(NodeUnavailableError(
+            f"no route from [{sender}] to [{to}] (disruption)"))
+    else:
+        on_error()
 
 
 class DisruptableTransport:
@@ -62,7 +87,8 @@ class DisruptableTransport:
                 # silent drop models a blackhole; on_error models a connection
                 # error, scheduled so timeouts still apply realistically
                 if on_error is not None:
-                    self.queue.schedule_at(delay, on_error)
+                    self.queue.schedule_at(
+                        delay, lambda: _invoke_on_error(on_error, sender, to))
                 return
 
             def reply_fn(reply_msg: dict) -> None:
@@ -72,7 +98,7 @@ class DisruptableTransport:
                     if self._delivery_ok(to, sender):
                         on_reply(reply_msg)
                     elif on_error is not None:
-                        on_error()
+                        _invoke_on_error(on_error, to, sender)
 
                 self.queue.schedule_at(rdelay, deliver_reply)
 

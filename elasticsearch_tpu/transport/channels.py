@@ -19,7 +19,7 @@ coordination uses the same convention, see cluster/cluster_service.py).
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Set, Tuple
 
 from elasticsearch_tpu.common.errors import ElasticsearchTpuError
 from elasticsearch_tpu.transport.service import TransportService
@@ -30,21 +30,48 @@ class NodeUnavailableError(ElasticsearchTpuError):
     error_type = "node_not_connected_exception"
 
 
+class RpcTimeoutError(ElasticsearchTpuError):
+    """An RPC did not answer within its deadline (ref:
+    ReceiveTimeoutTransportException): the coordinator stops waiting; the
+    late reply — if any — is dropped."""
+
+    status = 504
+    error_type = "receive_timeout_transport_exception"
+
+
+# Transport RPC actions that are named fault-injection sites (the
+# `rpc_*` half of the ES_TPU_FAULTS grammar, common/faults.py).
+_RPC_FAULT_SITES = {
+    "indices:data/read/search[phase/query]": "rpc_query",
+    "indices:data/read/search[phase/fetch/id]": "rpc_fetch",
+    "indices:data/read/search[can_match]": "rpc_can_match",
+}
+
+
 class NodeChannels:
     """request() raises NodeUnavailableError when the target is down."""
 
-    def request(self, node: str, action: str, payload: dict) -> dict:
+    def request(self, node: str, action: str, payload: dict,
+                source: Optional[str] = None) -> dict:
         raise NotImplementedError
 
 
 class LocalNodeChannels(NodeChannels):
-    """In-process dispatch between TransportServices, by node name."""
+    """In-process dispatch between TransportServices, by node name.
+
+    Disruption rules mirror testing/disruptable_transport.py's taxonomy —
+    kill (node death), isolate (one-sided cut from everyone), partition
+    (two-sided blackhole between groups), heal — and all of them surface as
+    the SAME `NodeUnavailableError` the fault-injection sites raise, so
+    injected and organic transport faults take identical recovery paths."""
 
     def __init__(self):
         self._services: Dict[str, TransportService] = {}
         self._killed: set = set()
+        self._isolated: set = set()
+        self._blackholed: Set[Tuple[str, str]] = set()
         self._lock = threading.Lock()
-        # test seam: fault(from_node?, to_node, action) -> raise to inject
+        # test seam: fault(to_node, action) -> raise to inject
         self.fault_hook: Optional[Callable[[str, str], None]] = None
 
     def register(self, name: str, service: TransportService) -> None:
@@ -60,14 +87,46 @@ class LocalNodeChannels(NodeChannels):
         with self._lock:
             self._killed.discard(name)
 
-    def request(self, node: str, action: str, payload: dict) -> dict:
+    # ---- partition rules (ref: DisruptableMockTransport) ----
+
+    def isolate(self, name: str) -> None:
+        """Cut `name` off from every other node (both directions)."""
+        with self._lock:
+            self._isolated.add(name)
+
+    def partition(self, side_a: Set[str], side_b: Set[str]) -> None:
+        """Two-sided blackhole between the groups."""
+        with self._lock:
+            for a in side_a:
+                for b in side_b:
+                    self._blackholed.add((a, b))
+                    self._blackholed.add((b, a))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._isolated.clear()
+            self._blackholed.clear()
+
+    def request(self, node: str, action: str, payload: dict,
+                source: Optional[str] = None) -> dict:
         with self._lock:
             if node in self._killed or node not in self._services:
                 raise NodeUnavailableError(f"node [{node}] is not connected")
+            if node in self._isolated or source in self._isolated:
+                raise NodeUnavailableError(
+                    f"node [{node}] is partitioned away")
+            if source is not None and (source, node) in self._blackholed:
+                raise NodeUnavailableError(
+                    f"no route from [{source}] to [{node}] (partition)")
             service = self._services[node]
+        site = _RPC_FAULT_SITES.get(action)
+        if site is not None:
+            from elasticsearch_tpu.common.faults import transport_fault_point
+
+            transport_fault_point(site, node)
         if self.fault_hook is not None:
             self.fault_hook(node, action)
-        return service.handle(action, payload, source_node="local")
+        return service.handle(action, payload, source_node=source or "local")
 
 
 class TcpNodeChannels(NodeChannels):
@@ -92,7 +151,8 @@ class TcpNodeChannels(NodeChannels):
                 host, port = n.address.rsplit(":", 1)
                 self.set_address(n.name, host, int(port))
 
-    def request(self, node: str, action: str, payload: dict) -> dict:
+    def request(self, node: str, action: str, payload: dict,
+                source: Optional[str] = None) -> dict:
         if node == self.self_name:
             # local short-circuit, as the reference does for local sends
             return self.self_service.handle(action, payload, source_node=node)
