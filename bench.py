@@ -955,6 +955,33 @@ def dryrun_disruption() -> int:
     return 0 if ok else 1
 
 
+def dryrun_lint() -> int:
+    """Fast-path check: tpulint over the whole package must be clean
+    (baselined findings allowed, stale baseline entries not). Pure AST —
+    no device, no index build, so this runs in seconds anywhere."""
+    from tools.tpulint.core import apply_baseline, lint_paths, load_baseline
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    findings = lint_paths(["elasticsearch_tpu"], root=root)
+    baseline = load_baseline(
+        os.path.join(root, "tools", "tpulint", "baseline.txt"))
+    fresh, stale = apply_baseline(findings, baseline)
+    for f in fresh:
+        log(f"tpulint: {f.render()}")
+    for path, line, rule in stale:
+        log(f"tpulint: stale baseline entry {path}:{line}: {rule}")
+    ok = not fresh and not stale
+    print(json.dumps({
+        "metric": "dryrun_lint",
+        "ok": bool(ok),
+        "findings": len(fresh),
+        "baselined": len(findings) - len(fresh),
+        "stale_baseline": len(stale),
+    }), flush=True)
+    log(f"dryrun_lint: findings={len(fresh)} stale={len(stale)}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -962,4 +989,7 @@ if __name__ == "__main__":
     if "dryrun_disruption" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_disruption":
         sys.exit(dryrun_disruption())
+    if "dryrun_lint" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_lint":
+        sys.exit(dryrun_lint())
     main()

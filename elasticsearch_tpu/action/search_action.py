@@ -35,7 +35,6 @@ partials at the coordinator instead of wedging the pool.
 from __future__ import annotations
 
 import dataclasses
-import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -45,6 +44,7 @@ from elasticsearch_tpu.common.errors import (
     SearchPhaseExecutionError,
 )
 from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.common.settings import knob
 from elasticsearch_tpu.indices.shard_service import DistributedShardService
 from elasticsearch_tpu.search.fetch_phase import execute_fetch_phase
 from elasticsearch_tpu.search.query_phase import (
@@ -63,18 +63,11 @@ ACTION_CAN_MATCH = "indices:data/read/search[can_match]"
 _PRE_FILTER_SHARD_SIZE = 4   # ref default is 128; our meshes are smaller
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 # ---- coordinator resilience counters (node-wide; `tpu_coordinator`
 #      section of GET /_nodes/stats) ----
 
 _COORD_LOCK = threading.Lock()
-_COORD_COUNTERS: Dict[str, int] = {
+_COORD_COUNTERS: Dict[str, int] = {  # guarded by: _COORD_LOCK
     "shard_retries": 0,        # failover attempts on a next-best copy
     "node_circuit_open": 0,    # candidates skipped on an open node circuit
     "rpc_timeouts": 0,         # RPCs abandoned past their deadline
@@ -337,7 +330,7 @@ class SearchActionService:
         # its own Turbo/BlockMax engine, shard-local stats, coordinator
         # fetch/reduce unchanged)
         qr: QuerySearchResult | None = None
-        if os.environ.get("ES_TPU_DISABLE_SHARD_SERVING") != "1":
+        if not knob("ES_TPU_DISABLE_SHARD_SERVING"):
             try:
                 qr = self._shard_serving(inst).try_query_phase(p["body"])
             except Exception:  # noqa: BLE001 — fast path never fails a query
@@ -536,7 +529,7 @@ class SearchActionService:
         no thread hop on the common path. A hung RPC is abandoned at the
         bound (`RpcTimeoutError`); its worker thread dies with the late
         reply instead of wedging a pool worker."""
-        floor_ms = float(_env_int("ES_TPU_RPC_TIMEOUT_MS", 0))
+        floor_ms = float(knob("ES_TPU_RPC_TIMEOUT_MS"))
         timeout_ms: Optional[float] = None
         if deadline is not None:
             rem = deadline.remaining_ms()
@@ -663,7 +656,7 @@ class SearchActionService:
         deadline = Deadline(timeout_ms) if timeout_ms is not None else None
         allow_partial = \
             body.get("allow_partial_search_results", True) is not False
-        retries_max = max(0, _env_int("ES_TPU_SEARCH_SHARD_RETRIES", 3))
+        retries_max = max(0, knob("ES_TPU_SEARCH_SHARD_RETRIES"))
 
         targets: List[_ShardTarget] = []
         for index in indices:

@@ -22,8 +22,8 @@ class CircuitBreaker:
         self.limit_bytes = limit_bytes
         self.overhead = overhead
         self.parent = parent
-        self._used = 0
-        self._trip_count = 0
+        self._used = 0        # guarded by: _lock
+        self._trip_count = 0  # guarded by: _lock
         self._lock = threading.Lock()
 
     @property

@@ -34,7 +34,6 @@ organic faults alike.
 from __future__ import annotations
 
 import contextlib
-import os
 import random
 import threading
 import time
@@ -42,6 +41,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.common.errors import DeviceFaultError, HbmOomError
+from elasticsearch_tpu.common.settings import knob
 
 TRANSPORT_SITES = frozenset({
     "rpc_query",         # coordinator -> data node shard query RPC
@@ -125,7 +125,7 @@ class FaultRecord:
 
 
 def parse_spec(spec: str) -> List[_Clause]:
-    seed = int(os.environ.get("ES_TPU_FAULTS_SEED", "0") or 0)
+    seed = knob("ES_TPU_FAULTS_SEED")
     clauses: List[_Clause] = []
     for raw in spec.split(";"):
         raw = raw.strip()
@@ -180,7 +180,7 @@ def parse_spec(spec: str) -> List[_Clause]:
 
 
 _LOCK = threading.Lock()
-_ACTIVE: Optional[List[_Clause]] = None
+_ACTIVE: Optional[List[_Clause]] = None  # guarded by: _LOCK
 
 
 def install(spec: str) -> None:
@@ -317,6 +317,6 @@ def device_dispatch(site: str, part: Optional[int] = None):
 
 # Environment-driven installation (parse errors fail LOUD at import — a
 # typo'd fault spec silently doing nothing would invalidate a chaos run).
-_env_spec = os.environ.get("ES_TPU_FAULTS")
+_env_spec = knob("ES_TPU_FAULTS")
 if _env_spec:
     install(_env_spec)

@@ -22,24 +22,17 @@ that have since been garbage-collected (cumulative totals survive).
 from __future__ import annotations
 
 import collections
-import os
 import threading
 import time
 import weakref
 from typing import Dict, Optional
 
+from elasticsearch_tpu.common.settings import knob
+
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
 _COUNTERS = ("device_faults", "circuit_opens", "circuit_reopens", "probes",
              "probe_successes", "fallback_queries")
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
 
 _REGISTRY: "weakref.WeakSet[EngineHealth]" = weakref.WeakSet()
 _NODE_LOCK = threading.Lock()
@@ -54,30 +47,29 @@ class EngineHealth:
     device-health `tpu_health` section)."""
 
     _REG = _REGISTRY
-    _TOTALS = _NODE_TOTALS
+    _TOTALS = _NODE_TOTALS  # guarded by: _NODE_LOCK
 
     def __init__(self, name: str, trip_n: Optional[int] = None,
                  backoff_ms: Optional[int] = None):
         self.name = name
         self.trip_n = (trip_n if trip_n is not None
-                       else _env_int("ES_TPU_HEALTH_TRIP_N", 3))
+                       else knob("ES_TPU_HEALTH_TRIP_N"))
         self.base_backoff_ms = (backoff_ms if backoff_ms is not None
-                                else _env_int("ES_TPU_HEALTH_BACKOFF_MS",
-                                              1000))
+                                else knob("ES_TPU_HEALTH_BACKOFF_MS"))
         self._lock = threading.Lock()
         self.state = CLOSED
         self.consecutive_faults = 0
         self.backoff_ms = self.base_backoff_ms
         self._retry_at = 0.0
         self._probing = False
-        self.counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
-        self._transitions: collections.deque = collections.deque(maxlen=16)
+        self.counters: Dict[str, int] = {k: 0 for k in _COUNTERS}  # guarded by: _lock
+        self._transitions: collections.deque = collections.deque(maxlen=16)  # guarded by: _lock
         self.last_fault: Optional[str] = None
         self._REG.add(self)
 
     # ---- state machine ----
 
-    def _move(self, state: str) -> None:
+    def _move(self, state: str) -> None:  # tpulint: holds=_lock
         self._transitions.append(f"{self.state}->{state}")
         self.state = state
 
@@ -126,7 +118,7 @@ class EngineHealth:
                   and self.consecutive_faults >= self.trip_n):
                 self._open(reopen=False)
 
-    def _open(self, reopen: bool) -> None:
+    def _open(self, reopen: bool) -> None:  # tpulint: holds=_lock
         self._move(OPEN)
         self._retry_at = time.monotonic() + self.backoff_ms / 1000.0
         self._bump("circuit_reopens" if reopen else "circuit_opens")
@@ -135,10 +127,12 @@ class EngineHealth:
         with self._lock:
             self._bump("fallback_queries", n)
 
-    def _bump(self, key: str, n: int = 1) -> None:
+    def _bump(self, key: str, n: int = 1) -> None:  # tpulint: holds=_lock
         self.counters[key] += n
         with _NODE_LOCK:
-            self._TOTALS[key] += n
+            # node totals surface through node_health_stats(), not the
+            # per-engine stats() payload
+            self._TOTALS[key] += n  # tpulint: disable=TPU005
 
     # ---- reporting ----
 

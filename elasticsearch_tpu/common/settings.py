@@ -10,7 +10,9 @@ registry that validates updates and notifies subscribers on dynamic changes.
 
 from __future__ import annotations
 
+import os
 import re
+from dataclasses import dataclass
 from typing import Any, Callable, Generic, Iterable, Mapping, TypeVar
 
 from elasticsearch_tpu.common.errors import IllegalArgumentError
@@ -198,6 +200,157 @@ class Settings(Mapping[str, Any]):
 
 
 Settings.EMPTY = Settings()
+
+
+# ---------------------------------------------------------------------------
+# ES_TPU_* environment knob registry (PR 7)
+#
+# Every process-level tuning knob the TPU serving stack reads from the
+# environment is DECLARED here once — name, type, default, one-line doc —
+# and read through `knob()`. tpulint rule TPU003 rejects direct
+# `os.environ` reads of ES_TPU_* anywhere else in the package and flags
+# `knob()` calls whose literal name is not declared below (misspellings
+# die at lint time, not as silently-inert knobs in production).
+# `effective_knobs()` renders the live values as the `tpu_settings`
+# section of GET /_nodes/stats so a running node can be audited, and
+# `python -m tools.tpulint --knob-table` generates the README table.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class EnvKnob:
+    """One declared ES_TPU_* environment knob."""
+
+    name: str
+    type: str          # 'int' | 'float' | 'str' | 'flag' ('1' == on)
+    default: Any       # None means "computed by the consumer"
+    doc: str
+
+
+ENV_KNOBS: dict[str, EnvKnob] = {}
+
+_KNOB_PARSERS: dict[str, Callable[[str], Any]] = {
+    "int": int,
+    "float": float,
+    "str": str,
+    # the pre-registry readers treated exactly "1" as on; keep that contract
+    "flag": lambda raw: raw == "1",
+}
+
+_UNSET = object()
+
+
+class UndeclaredKnobError(KeyError):
+    """An ES_TPU_* knob was read without being declared in the registry."""
+
+
+def declare_knob(name: str, type: str, default: Any, doc: str) -> EnvKnob:
+    if type not in _KNOB_PARSERS:
+        raise IllegalArgumentError(f"unknown knob type [{type}] for [{name}]")
+    k = EnvKnob(name, type, default, doc)
+    ENV_KNOBS[name] = k
+    return k
+
+
+def knob(name: str, default: Any = _UNSET) -> Any:
+    """Current value of a declared knob: the parsed environment value when
+    set, else `default` (usually the declared one; pass `default=` for
+    consumer-computed defaults like the pool sizes). Reads the environment
+    per call — tests toggle knobs mid-process — and falls back to the
+    default on an unparseable value, matching the lenient pre-registry
+    readers (a typo'd knob must not take a node down)."""
+    decl = ENV_KNOBS.get(name)
+    if decl is None:
+        raise UndeclaredKnobError(
+            f"ES_TPU knob [{name}] is not declared in "
+            f"common/settings.py — declare_knob() it")
+    fallback = decl.default if default is _UNSET else default
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return fallback
+    try:
+        return _KNOB_PARSERS[decl.type](raw)
+    except (TypeError, ValueError):
+        return fallback
+
+
+def effective_knobs() -> dict[str, dict]:
+    """{name: {value, default, source}} for the `tpu_settings` section of
+    GET /_nodes/stats — `source` says whether the environment or the
+    declared default is in effect right now."""
+    out: dict[str, dict] = {}
+    for name in sorted(ENV_KNOBS):
+        decl = ENV_KNOBS[name]
+        raw = os.environ.get(name)
+        out[name] = {
+            "value": knob(name),
+            "default": decl.default,
+            "type": decl.type,
+            "source": "env" if raw not in (None, "") else "default",
+        }
+    return out
+
+
+declare_knob("ES_TPU_PLUGINS", "str", "",
+             "Comma-separated plugin modules exposing install(node), "
+             "loaded at node startup")
+declare_knob("ES_TPU_FAULTS", "str", "",
+             "Fault-injection spec `site[#part]:mode[@nth][xcount][=arg]"
+             "[~prob];…` installed at import (common/faults.py)")
+declare_knob("ES_TPU_FAULTS_SEED", "int", 0,
+             "Seed for probabilistic (~prob) fault clauses")
+declare_knob("ES_TPU_HEALTH_TRIP_N", "int", 3,
+             "Consecutive device faults that open an engine's circuit")
+declare_knob("ES_TPU_HEALTH_BACKOFF_MS", "int", 1000,
+             "Base backoff before a half-open probe (doubles per reopen, "
+             "capped at 32x)")
+declare_knob("ES_TPU_COALESCE_US", "float", 2000.0,
+             "Dispatch-coalescer flush window in microseconds "
+             "(0 disables coalescing)")
+declare_knob("ES_TPU_TURBO_HBM", "int", 6 << 30,
+             "HBM budget in bytes for TurboBM25's int8 column cache")
+declare_knob("ES_TPU_TURBO_COLD_DF", "int", None,
+             "Doc-frequency threshold below which terms stay cold "
+             "(host-rescored); default: parallel/turbo.py COLD_DF")
+declare_knob("ES_TPU_TURBO_MESH", "int", None,
+             "Max devices for the fused multi-partition Turbo mesh "
+             "(default all visible; 0 disables fusion)")
+declare_knob("ES_TPU_FORCE_TURBO", "flag", False,
+             "'1' forces Turbo eligibility off-TPU (interpret-mode "
+             "differential tests)")
+declare_knob("ES_TPU_DISABLE_SHARD_SERVING", "flag", False,
+             "'1' disables the shard-level serving fast path on data nodes")
+declare_knob("ES_TPU_SEARCH_SHARD_RETRIES", "int", 3,
+             "Max replica-failover retries per shard before it counts "
+             "failed")
+declare_knob("ES_TPU_RPC_TIMEOUT_MS", "int", 0,
+             "Floor for the per-RPC deadline in ms (0 = request budget "
+             "only)")
+declare_knob("ES_TPU_TCP_TIMEOUT_S", "float", 30.0,
+             "Socket timeout for TcpNodeChannels remote RPCs, seconds")
+# thread-pool shape overrides (threadpool/pool.py computes the defaults
+# from the cpu count) — declared literally, one per pool, so tpulint's
+# static declared-name check sees every legal ES_TPU_POOL_* spelling
+declare_knob("ES_TPU_POOL_SEARCH_SIZE", "int", None,
+             "Worker count for the search pool (default 3*cpus/2+1)")
+declare_knob("ES_TPU_POOL_SEARCH_QUEUE", "int", None,
+             "Queue capacity for the search pool (default 1000)")
+declare_knob("ES_TPU_POOL_WRITE_SIZE", "int", None,
+             "Worker count for the write pool (default cpus)")
+declare_knob("ES_TPU_POOL_WRITE_QUEUE", "int", None,
+             "Queue capacity for the write pool (default 10000)")
+declare_knob("ES_TPU_POOL_GET_SIZE", "int", None,
+             "Worker count for the get pool (default cpus)")
+declare_knob("ES_TPU_POOL_GET_QUEUE", "int", None,
+             "Queue capacity for the get pool (default 1000)")
+declare_knob("ES_TPU_POOL_MANAGEMENT_SIZE", "int", None,
+             "Worker count for the management pool (default 2)")
+declare_knob("ES_TPU_POOL_MANAGEMENT_QUEUE", "int", None,
+             "Queue capacity for the management pool (default 512)")
+declare_knob("ES_TPU_POOL_SNAPSHOT_SIZE", "int", None,
+             "Worker count for the snapshot pool (default 1)")
+declare_knob("ES_TPU_POOL_SNAPSHOT_QUEUE", "int", None,
+             "Queue capacity for the snapshot pool (default 256)")
 
 
 class ClusterSettings:

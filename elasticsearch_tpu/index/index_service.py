@@ -57,9 +57,9 @@ class IndexService:
         self.serving = ServingContext(self)
         # shard request cache (ref: indices/IndicesRequestCache.java:57 —
         # caches size=0/aggs-only responses keyed on reader version + request)
-        self._req_cache: Dict[tuple, dict] = {}
+        self._req_cache: Dict[tuple, dict] = {}  # guarded by: _req_cache_lock
         self._req_cache_lock = threading.Lock()
-        self.request_cache_stats = {"hits": 0, "misses": 0}
+        self.request_cache_stats = {"hits": 0, "misses": 0}  # guarded by: _req_cache_lock
 
     # ---- document ops ----
 
@@ -180,10 +180,12 @@ class IndexService:
         if key is not None:
             with self._req_cache_lock:
                 hit = self._req_cache.get(key)
+                if hit is not None:
+                    self.request_cache_stats["hits"] += 1
+                else:
+                    self.request_cache_stats["misses"] += 1
             if hit is not None:
-                self.request_cache_stats["hits"] += 1
                 return _copy.deepcopy(hit)
-            self.request_cache_stats["misses"] += 1
         if searchers is None:
             resp = self.serving.try_search(request, search_type, task=task)
         else:
@@ -419,11 +421,14 @@ class IndexService:
 
     def stats(self) -> dict:
         total_segments = sum(s.segment_count() for s in self.shards)
+        with self._req_cache_lock:
+            request_cache = dict(self.request_cache_stats)
         return {
             "docs": {"count": self.doc_count(), "deleted": 0},
             "segments": {"count": total_segments},
             "store": {"size_in_bytes": sum(
                 sum(seg.ram_bytes() for seg in s._segments) for s in self.shards)},
+            "request_cache": request_cache,
         }
 
 

@@ -722,8 +722,9 @@ class TurboBM25:
             if check is not None:
                 check()
             wq, qscale, (rm, rr) = self._sweep(chunk, take)
-            pending.append((off, len(chunk),
-                            _pick_rows(rm, rr, n_rows=n_rows)))
+            with faults.device_errors("turbo_sweep", self.part_id):
+                picked = _pick_rows(rm, rr, n_rows=n_rows)
+            pending.append((off, len(chunk), picked))
             off += len(chunk)
         self.stats["dispatches"] += len(pending)
 
@@ -1328,7 +1329,9 @@ class TurboBM25:
                 check()
             rm, rr = self._sweep_bool([resolved[i] for i in sel],
                                       take)
-            pending.append((sel, _pick_rows(rm, rr, n_rows=n_rows)))
+            with faults.device_errors("turbo_sweep", self.part_id):
+                picked = _pick_rows(rm, rr, n_rows=n_rows)
+            pending.append((sel, picked))
             off += len(sel)
         self.stats["dispatches"] += len(pending)
 
@@ -1502,10 +1505,14 @@ class ShardedTurbo:
         lv = np.zeros((self.Sp, dp_rows, 128), np.float32)
         for i, t in enumerate(turbos):
             lv[i, : t.dp_rows] = t._live_host.reshape(t.dp_rows, 128)
-        self.live = jax.device_put(lv, sh)
-        zeros = np.zeros((self.Sp, dpc, self.Hp + 1, 16, 128), np.int8)
-        self.cols_hi = jax.device_put(zeros, sh)
-        self.cols_lo = jax.device_put(zeros, sh)
+        # translation only (device_errors, no fault_point): construction
+        # runs outside the serving containment ladder, so injecting here
+        # would fail engine build instead of degrading a query
+        with faults.device_errors("column_upload"):
+            self.live = jax.device_put(lv, sh)
+            zeros = np.zeros((self.Sp, dpc, self.Hp + 1, 16, 128), np.int8)
+            self.cols_hi = jax.device_put(zeros, sh)
+            self.cols_lo = jax.device_put(zeros, sh)
         self._sharding = sh
         self._epochs = [-1] * S
         self.fused_dispatches = 0

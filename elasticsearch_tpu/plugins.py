@@ -19,10 +19,10 @@ reference's policy: a broken plugin must not half-load).
 from __future__ import annotations
 
 import importlib
-import os
 from typing import List
 
 from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.common.settings import knob
 
 
 class PluginError(ElasticsearchTpuError):
@@ -37,7 +37,7 @@ def plugin_modules(settings) -> List[str]:
         names.extend(p for p in raw.split(",") if p)
     elif isinstance(raw, (list, tuple)):
         names.extend(raw)
-    env = os.environ.get("ES_TPU_PLUGINS", "")
+    env = knob("ES_TPU_PLUGINS")
     names.extend(p for p in env.split(",") if p)
     return names
 

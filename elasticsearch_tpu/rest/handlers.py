@@ -1903,6 +1903,7 @@ class _Handlers:
                 "tpu_turbo": _turbo_merge_stats(),
                 "tpu_health": _tpu_health_stats(),
                 "tpu_coordinator": _tpu_coordinator_stats(),
+                "tpu_settings": _tpu_settings_stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
         })
@@ -2225,6 +2226,16 @@ def _tpu_coordinator_stats() -> dict:
     from elasticsearch_tpu.action.search_action import coordinator_stats
 
     return coordinator_stats()
+
+
+def _tpu_settings_stats() -> dict:
+    """Effective ES_TPU_* knob values (PR 7): every declared knob with its
+    parsed value and whether it came from the environment or the default —
+    so a chaos/bench run's exact configuration is observable, not inferred
+    from shell history."""
+    from elasticsearch_tpu.common.settings import effective_knobs
+
+    return effective_knobs()
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

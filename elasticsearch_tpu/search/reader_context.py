@@ -45,7 +45,7 @@ class ReaderContextRegistry:
     def __init__(self, default_keep_alive_s: float = 300.0,
                  max_open_contexts: int = 500):
         self._lock = threading.Lock()
-        self._contexts: Dict[str, ReaderContext] = {}
+        self._contexts: Dict[str, ReaderContext] = {}  # guarded by: _lock
         self.default_keep_alive_s = default_keep_alive_s
         self.max_open_contexts = max_open_contexts
 

@@ -36,7 +36,6 @@ deterministic (score desc, partition asc, doc asc) tie-break.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from dataclasses import dataclass, field as dc_field
@@ -51,6 +50,7 @@ from elasticsearch_tpu.common.errors import (
 from elasticsearch_tpu.common.faults import FaultRecord
 from elasticsearch_tpu.index.positions import phrase_freqs
 from elasticsearch_tpu.ops import bm25_idf
+from elasticsearch_tpu.common.settings import knob
 from elasticsearch_tpu.search import queries as q
 from elasticsearch_tpu.search.queries import parse_query
 from elasticsearch_tpu.tasks.task_manager import (
@@ -68,9 +68,10 @@ _MAX_K = 1000
 
 # serving-path fault/containment counters (GET /_nodes/stats tpu_health)
 _SERVING_STATS = {"fastpath_reject_error": 0, "fastpath_device_fault": 0,
-                  "fastpath_timed_out": 0, "shard_fault_recoveries": 0}
+                  "fastpath_timed_out": 0,
+                  "shard_fault_recoveries": 0}  # guarded by: _SERVING_LOCK
 _SERVING_LOCK = threading.Lock()
-_LOGGED_REJECT_TYPES: set = set()
+_LOGGED_REJECT_TYPES: set = set()  # guarded by: _SERVING_LOCK
 
 
 def serving_fault_stats() -> dict:
@@ -329,19 +330,19 @@ def _flatten(node, plan: FlatPlan, mapper, ctx: str, weight: float) -> None:
 # --------------------------------------------------------------------------
 
 # HBM reserved for TurboBM25's int8 column cache when it is selected
-TURBO_HBM_BUDGET = int(os.environ.get("ES_TPU_TURBO_HBM", 6 << 30))
+TURBO_HBM_BUDGET = knob("ES_TPU_TURBO_HBM")
 
 
 def _env_cold_df() -> Optional[int]:
-    v = os.environ.get("ES_TPU_TURBO_COLD_DF")
-    return int(v) if v else None
+    return knob("ES_TPU_TURBO_COLD_DF")
 
 
 # node-wide Turbo partition-merge counters (every TurboEngine increments
 # these alongside its own merge_stats; GET /_nodes/stats surfaces them
 # next to the tpu_coalescer section)
 _TURBO_NODE_STATS = {"merge_device": 0, "merge_host": 0,
-                     "partition_dispatches": 0, "fused_dispatches": 0}
+                     "partition_dispatches": 0,
+                     "fused_dispatches": 0}  # guarded by: _TURBO_NODE_LOCK
 _TURBO_NODE_LOCK = threading.Lock()
 
 
@@ -364,12 +365,9 @@ def _turbo_mesh(n_partitions: int):
     from elasticsearch_tpu.parallel.spmd import make_mesh
 
     n = len(jax.devices())
-    v = os.environ.get("ES_TPU_TURBO_MESH")
-    if v:
-        try:
-            n = min(n, int(v))
-        except ValueError:
-            pass
+    cap = knob("ES_TPU_TURBO_MESH")
+    if cap is not None:
+        n = min(n, cap)
         if n <= 0:
             return None
     return make_mesh(min(n, n_partitions), dp=1)
@@ -401,14 +399,16 @@ class TurboEngine:
         self.mesh = mesh
         self._sharded = None
         self.health = EngineHealth("turbo")
+        self._stats_lock = threading.Lock()
         self.merge_stats = {"merge_device": 0, "merge_host": 0,
                             "partition_dispatches": 0,
-                            "fused_dispatches": 0}
+                            "fused_dispatches": 0}  # guarded by: _stats_lock
 
     def _count(self, key: str, n: int = 1) -> None:
         if n <= 0:
             return
-        self.merge_stats[key] += n
+        with self._stats_lock:
+            self.merge_stats[key] += n
         with _TURBO_NODE_LOCK:
             _TURBO_NODE_STATS[key] += n
 
@@ -628,7 +628,7 @@ def turbo_eligible(segments, field: str, mesh, *,
     from elasticsearch_tpu.parallel.kernels import SW
     from elasticsearch_tpu.parallel.turbo import COLD_DF
 
-    force = os.environ.get("ES_TPU_FORCE_TURBO") == "1"
+    force = knob("ES_TPU_FORCE_TURBO")
     if not force and jax.default_backend() != "tpu":
         return False
     if cold_df is None:

@@ -28,9 +28,10 @@ The flush window comes from `ES_TPU_COALESCE_US` (microseconds, default
 from __future__ import annotations
 
 import itertools
-import os
 import threading
 from typing import Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.common.settings import knob
 
 DEFAULT_WINDOW_US = 2000.0
 # a query batch larger than this is already a good device shape — merging
@@ -60,13 +61,8 @@ def _engine_key(engine) -> int:
 
 
 def _env_window_us() -> float:
-    v = os.environ.get("ES_TPU_COALESCE_US")
-    if v is None or v == "":
-        return DEFAULT_WINDOW_US
-    try:
-        return float(v)
-    except ValueError:
-        return DEFAULT_WINDOW_US
+    # per-call registry read: tests toggle the window mid-process
+    return knob("ES_TPU_COALESCE_US")
 
 
 def _accepts_fault_log(engine) -> bool:
@@ -121,13 +117,13 @@ class DispatchCoalescer:
         self.max_batch = max_batch
         self.small_batch_max = small_batch_max
         self._lock = threading.Lock()
-        self._pending: Dict[Tuple[int, int], _PendingBatch] = {}
+        self._pending: Dict[Tuple[int, int], _PendingBatch] = {}  # guarded by: _lock
         # stats
-        self._direct_dispatches = 0
-        self._coalesced_dispatches = 0
-        self._coalesced_queries = 0
-        self._largest_batch = 0
-        self._batch_retries = 0
+        self._direct_dispatches = 0      # guarded by: _lock
+        self._coalesced_dispatches = 0   # guarded by: _lock
+        self._coalesced_queries = 0      # guarded by: _lock
+        self._largest_batch = 0          # guarded by: _lock
+        self._batch_retries = 0          # guarded by: _lock
 
     def window_us(self) -> float:
         return self._window_us if self._window_us is not None \

@@ -25,6 +25,7 @@ from collections import deque
 from typing import Callable, Dict, Optional
 
 from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.common.settings import knob
 
 
 class EsRejectedExecutionError(ElasticsearchTpuError):
@@ -81,18 +82,18 @@ class FixedExecutor:
         self.name = name
         self.size = max(1, int(size))
         self.queue_size = max(0, int(queue_size))
-        self._queue: deque = deque()
+        self._queue: deque = deque()  # guarded by: _lock
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
-        self._threads: list = []
-        self._idle = 0
-        self._shutdown = False
+        self._threads: list = []      # guarded by: _lock
+        self._idle = 0                # guarded by: _lock
+        self._shutdown = False        # guarded by: _lock
         # stats (ref: ThreadPoolStats.Stats)
-        self.active = 0
-        self.largest = 0
-        self.completed = 0
-        self.rejected = 0
-        self.ewma_ms = 0.0
+        self.active = 0               # guarded by: _lock
+        self.largest = 0              # guarded by: _lock
+        self.completed = 0            # guarded by: _lock
+        self.rejected = 0             # guarded by: _lock
+        self.ewma_ms = 0.0            # guarded by: _lock
 
     def submit(self, fn: Callable, *args, **kwargs) -> _Task:
         task = _Task(fn, args, kwargs)
@@ -162,14 +163,6 @@ class FixedExecutor:
             self._work.notify_all()
 
 
-def _env_int(key: str, default: int) -> int:
-    v = os.environ.get(key)
-    try:
-        return int(v) if v else default
-    except ValueError:
-        return default
-
-
 # ---- request -> pool classification (the REST layer's stage routing;
 #      ref: the reference's per-action executor names in ActionModule) ----
 
@@ -220,10 +213,10 @@ class ThreadPool:
         }
         self.executors: Dict[str, FixedExecutor] = {}
         for name, (size, queue) in defaults.items():
-            size = (sizes or {}).get(name) or _env_int(
-                f"ES_TPU_POOL_{name.upper()}_SIZE", size)
-            queue = (queue_sizes or {}).get(name) or _env_int(
-                f"ES_TPU_POOL_{name.upper()}_QUEUE", queue)
+            size = (sizes or {}).get(name) or knob(
+                f"ES_TPU_POOL_{name.upper()}_SIZE", default=size)
+            queue = (queue_sizes or {}).get(name) or knob(
+                f"ES_TPU_POOL_{name.upper()}_QUEUE", default=queue)
             self.executors[name] = FixedExecutor(name, size, queue)
 
     def executor(self, pool: str) -> FixedExecutor:

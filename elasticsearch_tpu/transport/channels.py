@@ -22,6 +22,7 @@ import threading
 from typing import Callable, Dict, Optional, Set, Tuple
 
 from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.common.settings import knob
 from elasticsearch_tpu.transport.service import TransportService
 
 
@@ -66,10 +67,10 @@ class LocalNodeChannels(NodeChannels):
     injected and organic transport faults take identical recovery paths."""
 
     def __init__(self):
-        self._services: Dict[str, TransportService] = {}
-        self._killed: set = set()
-        self._isolated: set = set()
-        self._blackholed: Set[Tuple[str, str]] = set()
+        self._services: Dict[str, TransportService] = {}  # guarded by: _lock
+        self._killed: set = set()                         # guarded by: _lock
+        self._isolated: set = set()                       # guarded by: _lock
+        self._blackholed: Set[Tuple[str, str]] = set()    # guarded by: _lock
         self._lock = threading.Lock()
         # test seam: fault(to_node, action) -> raise to inject
         self.fault_hook: Optional[Callable[[str, str], None]] = None
@@ -133,11 +134,12 @@ class TcpNodeChannels(NodeChannels):
     """Framed-TCP dispatch using an address book (host, port) by name."""
 
     def __init__(self, self_name: str, self_service: TransportService,
-                 timeout: float = 30.0):
+                 timeout: Optional[float] = None):
         self.self_name = self_name
         self.self_service = self_service
-        self.timeout = timeout
-        self._addresses: Dict[str, Tuple[str, int]] = {}
+        self.timeout = timeout if timeout is not None \
+            else knob("ES_TPU_TCP_TIMEOUT_S")
+        self._addresses: Dict[str, Tuple[str, int]] = {}  # guarded by: _lock
         self._lock = threading.Lock()
 
     def set_address(self, name: str, host: str, port: int) -> None:

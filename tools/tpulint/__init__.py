@@ -1,0 +1,32 @@
+"""tpulint: project-specific static analysis for elasticsearch_tpu.
+
+The fault ladder (PR 5/6) and the bit-identity certificate only hold if
+every device dispatch goes through a named `common/faults.py` fault site,
+every shared counter is mutated under its lock, and every `ES_TPU_*` knob
+is parsed through the typed registry in `common/settings.py`. These are
+exactly the invariants review cannot keep from rotting at scale, so this
+package machine-checks them over the stdlib `ast` (no new dependencies):
+
+    TPU001 unguarded-dispatch   jit / shard_map / device_put call sites
+                                must sit inside a named fault site
+    TPU002 guarded-by           attributes annotated `# guarded by: _lock`
+                                may only be mutated under that lock
+    TPU003 knob-registry        ES_TPU_* env reads go through
+                                common/settings.py `knob()`; names must
+                                be declared there
+    TPU004 dtype-drift          int8/bf16 array arithmetic mixing bare
+                                Python literals (implicit promotion breaks
+                                the bit-identity certificate)
+    TPU005 counter-hygiene      counters a class increments must appear in
+                                its `stats()` surface
+
+Run: ``python -m tools.tpulint elasticsearch_tpu/``
+Suppress one line: ``# tpulint: disable=TPU001`` (comma-separate rules).
+Mark a helper that is documented to run with a lock already held:
+``def _bump(self):  # tpulint: holds=_lock``.
+Grandfathered findings live in ``tools/tpulint/baseline.txt`` — one line
+per finding with a reason; the `lint` pytest lane fails on any finding
+not in the baseline AND on any baseline entry that no longer fires.
+"""
+
+from tools.tpulint.core import Finding, lint_paths, lint_sources  # noqa: F401

@@ -1,0 +1,103 @@
+"""CLI: ``python -m tools.tpulint elasticsearch_tpu/``.
+
+Exit status 0 when every finding is baselined and every baseline entry
+still fires; 1 on new findings OR stale baseline entries (a stale entry
+means the underlying code moved — re-justify or drop it, the baseline
+never rots silently); 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.tpulint.core import apply_baseline, lint_paths, load_baseline
+from tools.tpulint.rules import RULE_DOCS
+
+DEFAULT_BASELINE = Path(__file__).parent / "baseline.txt"
+
+
+def knob_table() -> str:
+    """Markdown table of the declared ES_TPU_* knobs, generated from the
+    live registry (the README's knob section is this command's output)."""
+    from elasticsearch_tpu.common.settings import ENV_KNOBS
+
+    rows = [("Knob", "Type", "Default", "Description"),
+            ("----", "----", "-------", "-----------")]
+    for name in sorted(ENV_KNOBS):
+        k = ENV_KNOBS[name]
+        default = "computed" if k.default is None else repr(k.default)
+        rows.append((f"`{name}`", k.type, f"`{default}`", k.doc))
+    return "\n".join("| " + " | ".join(r) + " |" for r in rows)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools.tpulint",
+        description="Project-specific static analysis for elasticsearch_tpu")
+    ap.add_argument("paths", nargs="*", default=["elasticsearch_tpu"],
+                    help="files/directories to lint (default: the package)")
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="baseline file of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(keeps existing reasons; new entries get TODO)")
+    ap.add_argument("--select", action="append", default=[],
+                    help="run only these rules (comma-separated, repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the ES_TPU_* knob registry as markdown")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, summary in sorted(RULE_DOCS.items()):
+            print(f"{name}  {summary}")
+        return 0
+    if args.knob_table:
+        print(knob_table())
+        return 0
+
+    select = None
+    if args.select:
+        select = {r.strip().upper() for chunk in args.select
+                  for r in chunk.split(",") if r.strip()}
+        unknown = select - set(RULE_DOCS)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    findings = lint_paths(args.paths or ["elasticsearch_tpu"], select=select)
+
+    if args.write_baseline:
+        old = load_baseline(args.baseline) if not args.no_baseline else {}
+        lines = ["# tpulint baseline — grandfathered findings, one per line:",
+                 "#   path:line: RULE reason",
+                 "# Every entry must still fire (stale entries fail the run)",
+                 "# and must carry a one-line justification.", ""]
+        for f in findings:
+            reason = old.get(f.key, "TODO: justify or fix")
+            lines.append(f"{f.path}:{f.line}: {f.rule} {reason}")
+        Path(args.baseline).write_text("\n".join(lines) + "\n")
+        print(f"wrote {len(findings)} entries to {args.baseline}")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    fresh, stale = apply_baseline(findings, baseline)
+    for f in fresh:
+        print(f.render())
+    for path, line, rule in stale:
+        print(f"{args.baseline}: stale baseline entry {path}:{line}: {rule} "
+              f"no longer fires — re-justify or remove it")
+    n_base = len(findings) - len(fresh)
+    status = "FAIL" if (fresh or stale) else "OK"
+    print(f"tpulint: {len(fresh)} finding(s), {n_base} baselined, "
+          f"{len(stale)} stale baseline entr(ies) — {status}")
+    return 1 if (fresh or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,300 @@
+"""tpulint framework: findings, suppressions, baseline, file walking.
+
+Rules live in tools/tpulint/rules.py; this module owns everything rule
+implementations share — the `Finding` dataclass, per-file parse context
+(AST + parent links + `# tpulint:` comment directives), the project-wide
+pre-pass (jitted-callable registry, declared-knob registry) and the
+baseline machinery. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_DISABLE_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+)")
+_HOLDS_RE = re.compile(r"#\s*tpulint:\s*holds=([\w.]+)")
+_GUARDED_RE = re.compile(r"#\s*guarded by:\s*([\w.]+)")
+
+# decorator / constructor names that produce a device-dispatching callable
+JIT_TAILS = frozenset({"jit"})
+PARTIAL_TAILS = frozenset({"partial", "_partial"})
+SHARD_MAP_TAILS = frozenset({"shard_map", "_shard_map"})
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # posix-relative to the repo root
+    line: int
+    col: int
+    message: str
+
+    @property
+    def key(self) -> Tuple[str, int, str]:
+        return (self.path, self.line, self.rule)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by the rules
+# ---------------------------------------------------------------------------
+
+
+def dotted_tail(node: ast.AST) -> Optional[str]:
+    """Last segment of a Name/Attribute chain: `jax.jit` -> 'jit'."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Full dotted chain: `jax.numpy.int8` -> 'jax.numpy.int8', else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jitlike_call(node: ast.AST) -> bool:
+    """Call expression that RETURNS a device-dispatching callable:
+    `jax.jit(f)`, `partial(jax.jit, ...)`, `shard_map(f, ...)`,
+    `partial(shard_map, ...)`."""
+    if not isinstance(node, ast.Call):
+        return False
+    tail = dotted_tail(node.func)
+    if tail in JIT_TAILS or tail in SHARD_MAP_TAILS:
+        return True
+    if tail in PARTIAL_TAILS and node.args:
+        inner = dotted_tail(node.args[0])
+        return inner in JIT_TAILS or inner in SHARD_MAP_TAILS
+    return False
+
+
+def is_jit_decorated(fn: ast.AST) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        if dotted_tail(dec) in JIT_TAILS or dotted_tail(dec) in SHARD_MAP_TAILS:
+            return True
+        if is_jitlike_call(dec):
+            return True
+    return False
+
+
+class FileContext:
+    """One parsed source file plus its tpulint comment directives."""
+
+    def __init__(self, path: str, source: str):
+        self.path = Path(path).as_posix()
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=self.path)
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # line -> set of suppressed rule names ('ALL' suppresses every rule)
+        self.suppressed: Dict[int, Set[str]] = {}
+        # def-line -> lock name the function's author documents as held
+        self.holds: Dict[int, str] = {}
+        # line -> lock name from a `# guarded by: <lock>` annotation
+        self.guard_notes: Dict[int, str] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _DISABLE_RE.search(text)
+            if m:
+                rules = {r.strip().upper() for r in m.group(1).split(",")}
+                self.suppressed[i] = {r for r in rules if r} or {"ALL"}
+            m = _HOLDS_RE.search(text)
+            if m:
+                self.holds[i] = m.group(1).split(".")[-1]
+            m = _GUARDED_RE.search(text)
+            if m:
+                self.guard_notes[i] = m.group(1).split(".")[-1]
+
+    # -- tree navigation --
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def held_lock(self, fn: ast.AST) -> Optional[str]:
+        """Lock name from a `# tpulint: holds=<lock>` marker on the def."""
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return self.holds.get(fn.lineno)
+        return None
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressed.get(line)
+        return bool(rules) and (rule in rules or "ALL" in rules)
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Optional[Finding]:
+        line = getattr(node, "lineno", 1)
+        if self.is_suppressed(rule, line):
+            return None
+        return Finding(rule, self.path, line, getattr(node, "col_offset", 0),
+                       message)
+
+
+class Project:
+    """Package-wide pre-pass the per-file rules consult.
+
+    * ``jitted``: module -> names bound (at module or class level) to a
+      device-dispatching callable, so TPU001 can flag cross-module calls
+      like ``kernels.merge_topk(...)``.
+    * ``knob_names``: ES_TPU_* knobs declared via ``declare_knob`` in
+      common/settings.py, so TPU003 can flag undeclared/misspelled knobs.
+    """
+
+    def __init__(self, files: Sequence[FileContext]):
+        self.files = list(files)
+        self.by_path = {f.path: f for f in self.files}
+        self.jitted: Dict[str, Set[str]] = {}
+        self.knob_names: Set[str] = set()
+        for f in self.files:
+            mod = self._module_name(f.path)
+            self.jitted[mod] = self._collect_jitted(f.tree)
+            if f.path.endswith("common/settings.py"):
+                self.knob_names |= self._collect_knobs(f.tree)
+
+    @staticmethod
+    def _module_name(path: str) -> str:
+        p = Path(path)
+        parts = list(p.with_suffix("").parts)
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join(parts)
+
+    @staticmethod
+    def _collect_jitted(tree: ast.Module) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if is_jit_decorated(node):
+                    names.add(node.name)
+            elif isinstance(node, ast.Assign) and is_jitlike_call(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+        return names
+
+    @staticmethod
+    def _collect_knobs(tree: ast.Module) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and dotted_tail(node.func) == "declare_knob" \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                names.add(node.args[0].value)
+        return names
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+
+def _iter_py_files(paths: Sequence[str], root: Path) -> List[Path]:
+    out: List[Path] = []
+    for p in paths:
+        path = (root / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_dir():
+            out.extend(sorted(path.rglob("*.py")))
+        elif path.suffix == ".py":
+            out.append(path)
+    return out
+
+
+def lint_sources(items: Sequence[Tuple[str, str]],
+                 select: Optional[Set[str]] = None) -> List[Finding]:
+    """Lint in-memory (path, source) pairs — the unit-test entry point.
+    Paths are repo-relative and drive per-rule applicability."""
+    from tools.tpulint.rules import ALL_RULES
+
+    contexts = [FileContext(path, source) for path, source in items]
+    project = Project(contexts)
+    findings: List[Finding] = []
+    for ctx in contexts:
+        for rule in ALL_RULES:
+            if select and rule.name not in select:
+                continue
+            findings.extend(rule.check(ctx, project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               select: Optional[Set[str]] = None) -> List[Finding]:
+    rootp = Path(root) if root else Path.cwd()
+    items: List[Tuple[str, str]] = []
+    for file in _iter_py_files(paths, rootp):
+        try:
+            rel = file.relative_to(rootp)
+        except ValueError:
+            rel = file
+        items.append((rel.as_posix(), file.read_text()))
+    return lint_sources(items, select=select)
+
+
+# ---------------------------------------------------------------------------
+# Baseline: grandfathered findings, one justified line each
+# ---------------------------------------------------------------------------
+
+_BASELINE_RE = re.compile(r"^(?P<path>[^:#\s][^:]*):(?P<line>\d+):\s*"
+                          r"(?P<rule>TPU\d{3})\s+(?P<reason>.*)$")
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, int, str], str]:
+    """baseline.txt -> {(path, line, rule): reason}. Lines starting with
+    '#' and blank lines are comments; anything else must parse."""
+    entries: Dict[Tuple[str, int, str], str] = {}
+    text = Path(path).read_text() if Path(path).exists() else ""
+    for n, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _BASELINE_RE.match(line)
+        if not m:
+            raise ValueError(f"{path}:{n}: unparseable baseline entry: {raw!r}")
+        reason = m.group("reason").strip()
+        if not reason:
+            raise ValueError(f"{path}:{n}: baseline entry needs a reason")
+        entries[(m.group("path"), int(m.group("line")), m.group("rule"))] = reason
+    return entries
+
+
+def apply_baseline(findings: Sequence[Finding],
+                   baseline: Dict[Tuple[str, int, str], str]
+                   ) -> Tuple[List[Finding], List[Tuple[str, int, str]]]:
+    """Split into (non-baselined findings, stale baseline keys)."""
+    found = {f.key for f in findings}
+    fresh = [f for f in findings if f.key not in baseline]
+    stale = [k for k in baseline if k not in found]
+    return fresh, stale
