@@ -982,6 +982,83 @@ def dryrun_lint() -> int:
     return 0 if ok else 1
 
 
+def dryrun_chaos() -> int:
+    """Durability smoke (PR 8): form the crash-restart cluster, stream
+    acked bulks through a primary kill, a translog-fsync fault, and a
+    crash+restart with WAL replay, then assert the acked-write history is
+    linearizable (zero acked-write loss) and the durability counters moved.
+    One JSON line on stdout; exit 0/1."""
+    import tempfile
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.common import faults
+    from elasticsearch_tpu.common.durability import (
+        durability_stats, reset_for_tests,
+    )
+    from elasticsearch_tpu.testing.chaos import (
+        AckedWriteHistory, CrashRestartCluster,
+    )
+
+    reset_for_tests()
+    log("dryrun_chaos: forming crash-restart cluster...")
+    with tempfile.TemporaryDirectory() as tmp:
+        cluster = CrashRestartCluster(["m0", "d0", "d1", "d2"], tmp,
+                                      roles={"m0": ("master",)})
+        cluster.master().create_index("docs", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+            "mappings": {"properties": {"n": {"type": "integer"},
+                                        "body": {"type": "text"}}}})
+        history = AckedWriteHistory()
+        docs = [f"doc{i}" for i in range(12)]
+
+        def stream(value):
+            ops = [{"op": "index", "id": d,
+                    "source": {"n": value, "body": f"v{value}"}} for d in docs]
+            pend = [(op, history.invoke(op["id"], "write", value))
+                    for op in ops]
+            resp = cluster.master().bulk("docs", ops)
+            for (op, op_id), item in zip(pend, resp["items"]):
+                if item is not None and "error" not in item:
+                    history.respond(op["id"], op_id)
+
+        stream(1)
+        primary = cluster.store.current().primary_of("docs", 0).node_id
+        cluster.crash(primary)                       # promotion mid-stream
+        stream(2)
+        with faults.inject("translog_fsync:raise@1x1"):
+            stream(3)                                # WAL fault -> realloc
+        cluster.restart(primary)
+        survivor = next(n.node_name for n in cluster.nodes
+                        if n.node_name != "m0")
+        cluster.crash(survivor, report=False)
+        cluster.restart(survivor)                    # commit + WAL replay
+        stream(4)
+        for d in docs:
+            src = cluster.read_doc("docs", d)
+            history.record_read(d, None if src is None else src["n"])
+        bad = history.check()
+        stats = durability_stats()
+    ok = (not bad and stats["fsync_shard_failures"] >= 1
+          and stats["recoveries_started"] >= 1
+          and stats["translog_replays"] >= 1)
+    print(json.dumps({
+        "metric": "dryrun_chaos",
+        "ok": bool(ok),
+        "non_linearizable_docs": len(bad),
+        "fsync_shard_failures": int(stats["fsync_shard_failures"]),
+        "recoveries_started": int(stats["recoveries_started"]),
+        "recoveries_retried": int(stats["recoveries_retried"]),
+        "translog_replays": int(stats["translog_replays"]),
+        "ghost_cleanups": int(stats["ghost_cleanups"]),
+    }), flush=True)
+    log(f"dryrun_chaos: lost_docs={len(bad)} "
+        f"fsync_shard_failures={stats['fsync_shard_failures']}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -992,4 +1069,7 @@ if __name__ == "__main__":
     if "dryrun_lint" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_lint":
         sys.exit(dryrun_lint())
+    if "dryrun_chaos" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_chaos":
+        sys.exit(dryrun_chaos())
     main()

@@ -37,7 +37,8 @@ from elasticsearch_tpu.common.errors import (
     ElasticsearchTpuError, IllegalArgumentError, IndexClosedError,
     IndexNotFoundError, ResourceAlreadyExistsError,
 )
-from elasticsearch_tpu.common.settings import Settings
+from elasticsearch_tpu.common.settings import Settings, knob
+from elasticsearch_tpu.index.translog import TranslogFsyncError
 from elasticsearch_tpu.indices.cluster_state_service import (
     IndicesClusterStateService,
 )
@@ -379,12 +380,20 @@ class ClusterNode:
     def health(self) -> dict:
         return self.state.health()
 
-    def bulk(self, index: str, ops: List[dict], retries: int = 20,
-             retry_delay: float = 0.1) -> dict:
+    def bulk(self, index: str, ops: List[dict],
+             retries: Optional[int] = None,
+             retry_delay: Optional[float] = None) -> dict:
         """Coordinator-side bulk: group by shard, dispatch to primaries
         (ref: action/bulk/TransportBulkAction.java:164 + the replication
         template). Retries on stale routing — a promoted primary or a moved
-        shard shows up in a later cluster state."""
+        shard shows up in a later cluster state — and on a primary whose
+        WAL failed (the master reallocates it). Retry count/delay default
+        from ES_TPU_BULK_RETRIES / ES_TPU_BULK_RETRY_MS; the whole dispatch
+        is bounded by ES_TPU_BULK_TIMEOUT_MS (0 = no deadline)."""
+        if retries is None:
+            retries = knob("ES_TPU_BULK_RETRIES")
+        if retry_delay is None:
+            retry_delay = knob("ES_TPU_BULK_RETRY_MS") / 1000.0
         index = self.resolve_write_index(index)
         state = self.state
         meta = state.indices.get(index)
@@ -409,17 +418,36 @@ class ClusterNode:
                        retries: int, retry_delay: float) -> dict:
         results: List[Optional[dict]] = [None] * len(ops)
         errors = False
+        timeout_ms = knob("ES_TPU_BULK_TIMEOUT_MS")
+        deadline = time.monotonic() + timeout_ms / 1000.0 if timeout_ms else None
         for sid, items in by_shard.items():
             payload_ops = [op for _, op in items]
             resp = None
             last_err: Optional[Exception] = None
             for attempt in range(retries):
+                if deadline is not None and time.monotonic() >= deadline:
+                    last_err = ElasticsearchTpuError(
+                        f"bulk deadline ({timeout_ms}ms) exceeded; "
+                        f"last error: {last_err}")
+                    break
                 state = self.state
                 primary = state.primary_of(index, sid)
                 if primary is None or primary.node_id is None \
                         or primary.state != "STARTED":
                     last_err = ElasticsearchTpuError(
                         f"no started primary for [{index}][{sid}]")
+                    time.sleep(retry_delay)
+                    continue
+                # circuit-aware dispatch: don't burn a retry on a node the
+                # transport breaker already holds OPEN — wait for its
+                # half-open probe window instead. allow_request() is
+                # consulted immediately before the attempt (an admitted
+                # probe that is never attempted wedges the circuit).
+                circuit = self.search_action._node_circuit(primary.node_id)
+                if not circuit.allow_request():
+                    last_err = ElasticsearchTpuError(
+                        f"transport circuit open for node "
+                        f"[{primary.node_id}]")
                     time.sleep(retry_delay)
                     continue
                 try:
@@ -429,9 +457,16 @@ class ClusterNode:
                          "primary_term": state.indices[index].primary_term(sid),
                          "ops": payload_ops,
                          "ops_bytes": _ops_bytes(payload_ops)})
+                    self.search_action._record_transport_outcome(
+                        primary.node_id)
                     break
                 except (NodeUnavailableError, ShardNotFoundError,
-                        PrimaryTermMismatchError) as e:
+                        PrimaryTermMismatchError, TranslogFsyncError) as e:
+                    # TranslogFsyncError: the primary refused to ack into a
+                    # broken WAL and failed itself; a later state carries
+                    # the promoted/reallocated copy — retry there.
+                    self.search_action._record_transport_outcome(
+                        primary.node_id, e)
                     last_err = e
                     time.sleep(retry_delay)
             if resp is None:

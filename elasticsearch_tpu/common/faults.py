@@ -12,8 +12,11 @@ Spec grammar (';'-separated clauses)::
     site[#part]:mode[@nth][xcount][=arg][~prob]
 
       site   one of KNOWN_SITES: device dispatch sites (turbo_sweep,
-             fused_dispatch, merge_kernel, column_upload, blockmax_pass) or
-             transport RPC sites (rpc_query, rpc_fetch, rpc_can_match)
+             fused_dispatch, merge_kernel, column_upload, blockmax_pass),
+             transport RPC sites — query path (rpc_query, rpc_fetch,
+             rpc_can_match) and write path (rpc_bulk, rpc_replica_bulk,
+             rpc_recovery, rpc_resync) — or durability sites
+             (translog_fsync, translog_corrupt, segment_commit)
       #part  restrict to one partition id — or, for transport sites, to one
              TARGET NODE by name (``rpc_query#d1``); default: any
       mode   raise | oom | hang
@@ -47,6 +50,18 @@ TRANSPORT_SITES = frozenset({
     "rpc_query",         # coordinator -> data node shard query RPC
     "rpc_fetch",         # coordinator -> data node fetch-by-id RPC
     "rpc_can_match",     # coordinator -> data node can_match pre-filter RPC
+    "rpc_bulk",          # coordinator -> primary node shard bulk RPC
+    "rpc_replica_bulk",  # primary -> replica replication fan-out RPC
+    "rpc_recovery",      # target -> source peer-recovery RPCs (all phases)
+    "rpc_resync",        # new primary -> replica resync RPCs
+})
+
+# Durable-storage sites (translog / segment commit): failures here must
+# surface as I/O errors on the WRITE path, not as unreachable nodes.
+DURABILITY_SITES = frozenset({
+    "translog_fsync",    # fsync of an appended translog record
+    "translog_corrupt",  # bit-rot the record being appended (bad CRC)
+    "segment_commit",    # segment + commit-point persistence in flush()
 })
 
 KNOWN_SITES = frozenset({
@@ -55,7 +70,7 @@ KNOWN_SITES = frozenset({
     "merge_kernel",      # device-side partition top-k merge
     "column_upload",     # int8 column build/refresh onto the device
     "blockmax_pass",     # BlockMax engine device pass
-}) | TRANSPORT_SITES
+}) | TRANSPORT_SITES | DURABILITY_SITES
 
 _MODES = frozenset({"raise", "oom", "hang"})
 
@@ -274,6 +289,44 @@ def transport_fault_point(site: str, node: str) -> None:
 
     raise NodeUnavailableError(
         f"injected transport fault at {site}#{node}")
+
+
+class DurabilityFaultError(OSError):
+    """Injected durable-storage failure (fsync / commit) at a named site.
+
+    Deliberately an OSError: the write path must treat an injected fsync
+    failure exactly like the organic ENOSPC/EIO it models."""
+
+    def __init__(self, message: str, site: Optional[str] = None,
+                 part: Optional[Any] = None):
+        super().__init__(message)
+        self.site = site
+        self.part = part
+
+
+def durability_fault_point(site: str, part: Optional[Any] = None) -> None:
+    """Named durable-storage site (translog fsync, segment commit): raises
+    `DurabilityFaultError` — indistinguishable from an organic I/O error —
+    or hangs (a stalling disk; the op completes late)."""
+    hit = _fire_mode(site, part)
+    if hit is None:
+        return
+    mode, arg = hit
+    if mode == "hang":
+        time.sleep(arg)
+        return
+    # raise and oom both model a failed durable write at a storage site
+    raise DurabilityFaultError(
+        f"injected durability fault at {site}"
+        + (f"#{part}" if part is not None else ""), site=site, part=part)
+
+
+def corruption_fires(part: Optional[Any] = None) -> bool:
+    """True when a `translog_corrupt` clause fires for this append: the
+    caller writes the record with a broken checksum (bit rot on the way to
+    disk) instead of raising — the damage surfaces at REPLAY time, like
+    real corruption does."""
+    return _fire_mode("translog_corrupt", part) is not None
 
 
 def is_device_error(e: BaseException) -> bool:

@@ -351,6 +351,24 @@ declare_knob("ES_TPU_POOL_SNAPSHOT_SIZE", "int", None,
              "Worker count for the snapshot pool (default 1)")
 declare_knob("ES_TPU_POOL_SNAPSHOT_QUEUE", "int", None,
              "Queue capacity for the snapshot pool (default 256)")
+# write-path durability / resilience (PR 8)
+declare_knob("ES_TPU_TRANSLOG_SYNC_OPS", "int", 128,
+             "Async-durability exposure bound: fsync the translog every N "
+             "appended ops (request durability syncs every op)")
+declare_knob("ES_TPU_BULK_RETRIES", "int", 20,
+             "Coordinator bulk retry attempts per shard before the items "
+             "fail with unavailable_shards_exception")
+declare_knob("ES_TPU_BULK_RETRY_MS", "int", 100,
+             "Delay between coordinator bulk retries, ms")
+declare_knob("ES_TPU_BULK_TIMEOUT_MS", "int", 0,
+             "Overall coordinator bulk deadline in ms (0 = retries bound "
+             "the wait on their own)")
+declare_knob("ES_TPU_RECOVERY_RETRIES", "int", 3,
+             "Peer-recovery attempts per replica before it is reported "
+             "shard-failed to the master")
+declare_knob("ES_TPU_RECOVERY_BACKOFF_MS", "int", 50,
+             "Base backoff between peer-recovery retries, ms (doubles per "
+             "attempt)")
 
 
 class ClusterSettings:

@@ -31,11 +31,13 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from elasticsearch_tpu.common.durability import count as _count_durability
 from elasticsearch_tpu.common.errors import DocumentMissingError, VersionConflictError
+from elasticsearch_tpu.common.faults import durability_fault_point
 from elasticsearch_tpu.index.segment import Segment, SegmentBuilder
 from elasticsearch_tpu.index.segment_io import segment_from_blob, segment_to_blob
 from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
-from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.index.translog import Translog, TranslogFsyncError
 from elasticsearch_tpu.mapper.mapper_service import MapperService
 
 
@@ -105,10 +107,14 @@ class InternalEngine:
         self._next_seg_id = 0
         self._last_committed_checkpoint = NO_OPS_PERFORMED
         self._refresh_listeners: List = []
+        # tragic-event latch (ref: Engine.failEngine): once the WAL failed
+        # under this engine, no further write may be accepted — the copy is
+        # failed via the master and replaced by a fresh instance
+        self._failed_reason: Optional[str] = None
         if data_path is not None:
             os.makedirs(data_path, exist_ok=True)
             self.translog = Translog(os.path.join(data_path, "translog"), translog_durability)
-            self._maybe_recover()
+            self.recover_from_disk()
         else:
             self.translog = None
 
@@ -128,6 +134,7 @@ class InternalEngine:
     ) -> EngineResult:
         """Index or update one document (ref: InternalEngine.index:842)."""
         with self._lock:
+            self._check_not_failed()
             self._check_op_term(op_primary_term)
             entry = self._versions.get(doc_id)
             exists = entry is not None and not entry.deleted
@@ -160,7 +167,7 @@ class InternalEngine:
                 self._buffer_order.append(doc_id)
             self._versions[doc_id] = _VersionEntry(seq_no=seq, version=version, deleted=False, in_buffer=True)
             if self.translog is not None and not from_translog:
-                self.translog.add(
+                self._translog_add(
                     {"op": "index", "id": doc_id, "seq_no": seq,
                      "primary_term": self.primary_term, "version": version, "source": source}
                 )
@@ -179,6 +186,7 @@ class InternalEngine:
         op_primary_term: Optional[int] = None,
     ) -> EngineResult:
         with self._lock:
+            self._check_not_failed()
             self._check_op_term(op_primary_term)
             entry = self._versions.get(doc_id)
             exists = entry is not None and not entry.deleted
@@ -212,10 +220,32 @@ class InternalEngine:
                 self._tombstone(entry.seg_idx, entry.ord)
             self._versions[doc_id] = _VersionEntry(seq_no=seq, version=version, deleted=True)
             if self.translog is not None and not from_translog:
-                self.translog.add({"op": "delete", "id": doc_id, "seq_no": seq,
-                                   "primary_term": self.primary_term, "version": version})
+                self._translog_add({"op": "delete", "id": doc_id, "seq_no": seq,
+                                    "primary_term": self.primary_term, "version": version})
             self._seqno.mark_processed(seq)
             return EngineResult(doc_id, version, seq, self.primary_term, "deleted")
+
+    def _check_not_failed(self) -> None:  # tpulint: holds=_lock
+        if self._failed_reason is not None:
+            raise TranslogFsyncError(
+                f"engine failed [{self._failed_reason}]; the shard copy "
+                f"must be reallocated, not written to")
+
+    def _translog_add(self, op: dict) -> None:  # tpulint: holds=_lock
+        """Append one op to the WAL; a failed fsync is a tragic event: the
+        engine latches failed so no later write can be acked into a WAL
+        that already lost a record (ref: InternalEngine failOnTragicEvent).
+        The in-memory effect of THIS op stays — it was never acked, and a
+        write surviving unacked is the safe direction."""
+        try:
+            self.translog.add(op)
+        except TranslogFsyncError as e:
+            self._failed_reason = str(e)
+            raise
+
+    @property
+    def failed_reason(self) -> Optional[str]:
+        return self._failed_reason
 
     def _check_op_term(self, op_primary_term: Optional[int]) -> None:
         """Primary-term fencing on the replica path (ref: IndexShard
@@ -438,6 +468,13 @@ class InternalEngine:
         if self.data_path is None:
             return
         with self._lock:
+            try:
+                durability_fault_point("segment_commit")
+            except OSError:
+                # a failed commit loses nothing durable: the previous commit
+                # point + translog tail still recover every op
+                _count_durability("segment_commit_failures")
+                raise
             self.refresh()
             seg_dir = os.path.join(self.data_path, "segments")
             os.makedirs(seg_dir, exist_ok=True)
@@ -467,9 +504,10 @@ class InternalEngine:
             self._last_committed_checkpoint = self._seqno.checkpoint
             self.translog.trim_below(gen)
 
-    def _maybe_recover(self) -> None:
+    def recover_from_disk(self) -> None:
         """Crash recovery: load committed segments, replay translog tail
-        (ref: index/shard/StoreRecovery.java + translog replay)."""
+        (ref: index/shard/StoreRecovery.java + translog replay). Called
+        from __init__ and by the crash-restart harness's reopened nodes."""
         commit_path = os.path.join(self.data_path, "commit.json")
         committed_cp = NO_OPS_PERFORMED
         if os.path.exists(commit_path):
@@ -498,11 +536,16 @@ class InternalEngine:
                         )
                         self._seqno.mark_processed(int(seg.seq_nos[ord_]))
         # replay translog tail
+        replayed = 0
         for op in self.translog.read_ops(min_seq_no=committed_cp):
             if op["op"] == "index":
                 self.index(op["id"], op["source"], seq_no=op["seq_no"], from_translog=True)
             else:
                 self.delete(op["id"], seq_no=op["seq_no"], from_translog=True)
+            replayed += 1
+        if replayed:
+            _count_durability("translog_replays")
+            _count_durability("translog_replayed_ops", replayed)
 
     # ---------------- peer-recovery snapshot transfer ----------------
 

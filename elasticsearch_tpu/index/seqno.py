@@ -119,3 +119,10 @@ class ReplicationTracker:
     def in_sync_ids(self) -> set[str]:
         with self._lock:
             return set(self._in_sync)
+
+    @property
+    def tracked_ids(self) -> set[str]:
+        """Every tracked copy, in-sync or still recovering — the superset a
+        ghost-tracking cleanup must consult."""
+        with self._lock:
+            return set(self._local_checkpoints)

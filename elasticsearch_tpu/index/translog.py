@@ -7,6 +7,12 @@ Generations roll over on flush; recovery replays ops above the last commit's
 checkpoint. Fsync policy mirrors index.translog.durability request/async.
 
 Record framing: [u32 length][u32 crc32 of payload][payload utf-8 json]
+
+Fault ladder (PR 8): every fsync runs through the ``translog_fsync`` fault
+site and surfaces failure as `TranslogFsyncError` — the caller must NOT ack
+the op (the shard copy gets failed via the master instead of writing into a
+broken WAL). The ``translog_corrupt`` site bit-rots the record being
+appended (bad CRC), so the damage surfaces at replay, like the real thing.
 """
 
 from __future__ import annotations
@@ -18,11 +24,26 @@ import threading
 import zlib
 from typing import Any, Dict, Iterator, List
 
+from elasticsearch_tpu.common.durability import count as _count
+from elasticsearch_tpu.common.durability import register_translog
+from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+from elasticsearch_tpu.common.faults import corruption_fires, durability_fault_point
+from elasticsearch_tpu.common.settings import knob
+
 _HEADER = struct.Struct("<II")
 
 
 class TranslogCorruptedError(Exception):
     pass
+
+
+class TranslogFsyncError(ElasticsearchTpuError):
+    """A translog fsync failed: the op is NOT durable and must not be acked
+    (ref: the reference fails the engine on a tragic translog event —
+    Engine.failEngine via TranslogException)."""
+
+    status = 503
+    error_type = "translog_fsync_exception"
 
 
 class Translog:
@@ -33,7 +54,8 @@ class Translog:
         self._lock = threading.Lock()
         self._generation = self._latest_generation()
         self._file = open(self._gen_path(self._generation), "ab")
-        self._ops_since_sync = 0
+        self._ops_since_sync = 0  # guarded by: _lock
+        register_translog(self)
 
     # ---- paths/generations ----
 
@@ -59,26 +81,57 @@ class Translog:
 
     def add(self, op: Dict[str, Any]) -> None:
         payload = json.dumps(op, separators=(",", ":")).encode()
-        rec = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        crc = zlib.crc32(payload)
+        if corruption_fires():
+            # bit-rot the checksum, not the raise path: real corruption is
+            # silent at write time and detected at replay
+            crc ^= 0x5A5A5A5A
+            _count("translog_corruptions")
+        rec = _HEADER.pack(len(payload), crc) + payload
         with self._lock:
             self._file.write(rec)
             if self.durability == "request":
-                self._file.flush()
-                os.fsync(self._file.fileno())
+                self._sync_locked()
             else:
                 self._ops_since_sync += 1
+                # bound the async exposure window: at most N acked-but-
+                # unsynced ops can be lost to a crash (ref: the reference's
+                # async durability still syncs on the flush interval; an
+                # unread counter bounds nothing)
+                if self._ops_since_sync >= knob("ES_TPU_TRANSLOG_SYNC_OPS"):
+                    self._sync_locked()
+
+    def _sync_locked(self) -> None:  # tpulint: holds=_lock
+        """Flush + fsync the active generation; resets the async window.
+
+        On failure (injected via the ``translog_fsync`` site or organic
+        EIO/ENOSPC) the record MAY still be in the file — the write preceded
+        the failed sync — but the caller must treat the op as NOT durable:
+        a write surviving unacked is safe, an acked write lost is not."""
+        try:
+            durability_fault_point("translog_fsync")
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        except OSError as e:
+            _count("fsync_failures")
+            raise TranslogFsyncError(f"translog fsync failed: {e}") from e
+        self._ops_since_sync = 0
+        _count("translog_syncs")
 
     def sync(self) -> None:
         with self._lock:
-            self._file.flush()
-            os.fsync(self._file.fileno())
-            self._ops_since_sync = 0
+            self._sync_locked()
+
+    @property
+    def ops_since_sync(self) -> int:
+        """Current async-durability exposure: ops appended since the last
+        successful fsync (0 under request durability)."""
+        return self._ops_since_sync
 
     def rollover(self) -> int:
         """Start a new generation (called at flush/commit time)."""
         with self._lock:
-            self._file.flush()
-            os.fsync(self._file.fileno())
+            self._sync_locked()
             self._file.close()
             self._generation += 1
             self._file = open(self._gen_path(self._generation), "ab")

@@ -117,14 +117,34 @@ class IndicesClusterStateService:
 
     def _defer_recovery(self, inst) -> None:
         def recover():
-            try:
-                self.shards.recover_replica(inst)
-            except Exception as e:  # noqa: BLE001
+            import time
+
+            from elasticsearch_tpu.common.durability import count
+            from elasticsearch_tpu.common.settings import knob
+
+            # a dying source or an injected transport blip must not cost
+            # the copy outright: every recovery step is idempotent, so
+            # retry with exponential backoff before telling the master
+            # (ref: PeerRecoveryTargetService retryRecovery)
+            attempts = max(1, knob("ES_TPU_RECOVERY_RETRIES"))
+            backoff = knob("ES_TPU_RECOVERY_BACKOFF_MS") / 1000.0
+            last_err: Optional[Exception] = None
+            for attempt in range(attempts):
+                if attempt:
+                    count("recoveries_retried")
+                    time.sleep(backoff * (2 ** (attempt - 1)))
+                try:
+                    self.shards.recover_replica(inst)
+                    last_err = None
+                    break
+                except Exception as e:  # noqa: BLE001
+                    last_err = e
+            if last_err is not None:
                 self.master_client(
                     "internal:cluster/shard/failed",
                     {"index": inst.index, "shard_id": inst.shard_id,
                      "allocation_id": inst.allocation_id,
-                     "reason": f"recovery failed: {e}"})
+                     "reason": f"recovery failed: {last_err}"})
                 return
             inst.state = "STARTED"
             self.master_client(

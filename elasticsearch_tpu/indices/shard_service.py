@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.common.durability import count as _count
 from elasticsearch_tpu.common.errors import (
     ElasticsearchTpuError, VersionConflictError,
 )
@@ -35,8 +36,13 @@ from elasticsearch_tpu.cluster.state import ClusterState, IndexMetadata, ShardRo
 from elasticsearch_tpu.index.engine import InternalEngine
 from elasticsearch_tpu.index.replication import resync_target_apply
 from elasticsearch_tpu.index.seqno import NO_OPS_PERFORMED, ReplicationTracker
+from elasticsearch_tpu.index.translog import (
+    TranslogCorruptedError, TranslogFsyncError,
+)
 from elasticsearch_tpu.mapper import MapperService
-from elasticsearch_tpu.transport.channels import NodeChannels, NodeUnavailableError
+from elasticsearch_tpu.transport.channels import (
+    NodeChannels, NodeUnavailableError, RpcTimeoutError,
+)
 from elasticsearch_tpu.transport.service import TransportService
 
 
@@ -134,6 +140,8 @@ class DistributedShardService:
                                    self._on_recovery_ops)
         t.register_request_handler("internal:index/shard/recovery/finalize",
                                    self._on_recovery_finalize)
+        t.register_request_handler("internal:index/shard/recovery/cancel",
+                                   self._on_recovery_cancel)
         t.register_request_handler("internal:index/shard/resync/prepare",
                                    self._on_resync_prepare)
         t.register_request_handler("internal:index/shard/resync/apply",
@@ -158,9 +166,26 @@ class DistributedShardService:
             path = os.path.join(self.data_path, meta.index,
                                 str(routing.shard_id))
         durability = meta.settings.raw("index.translog.durability", "request")
-        engine = InternalEngine(mapper, data_path=path,
-                                primary_term=meta.primary_term(routing.shard_id),
-                                translog_durability=durability)
+        try:
+            engine = InternalEngine(
+                mapper, data_path=path,
+                primary_term=meta.primary_term(routing.shard_id),
+                translog_durability=durability)
+        except TranslogCorruptedError:
+            # a replica's store is expendable: quarantine the damaged dir and
+            # re-bootstrap empty via peer recovery (ref: the reference drops
+            # a corrupt replica store and recovers from the primary). A
+            # primary has nothing to recover FROM — surface the corruption.
+            if routing.primary or path is None:
+                raise
+            import shutil
+            shutil.rmtree(path + ".corrupt", ignore_errors=True)
+            os.rename(path, path + ".corrupt")
+            _count("store_corruptions_discarded")
+            engine = InternalEngine(
+                mapper, data_path=path,
+                primary_term=meta.primary_term(routing.shard_id),
+                translog_durability=durability)
         inst = ShardInstance(
             index=meta.index, shard_id=routing.shard_id,
             allocation_id=routing.allocation_id, primary=routing.primary,
@@ -196,48 +221,63 @@ class DistributedShardService:
                 f"request term [{req_term}] below current "
                 f"[{inst.primary_term}]")
         ops_bytes = p.get("ops_bytes") or _ops_bytes(p["ops"])
-        with self.indexing_pressure.primary(ops_bytes), inst.lock:
-            results: List[dict] = []
-            rep_ops: List[dict] = []
-            for op in p["ops"]:
-                try:
-                    if op["op"] in ("index", "create"):
-                        r = inst.engine.index(
-                            op["id"], op["source"], op_type=op["op"],
-                            if_seq_no=op.get("if_seq_no"),
-                            if_primary_term=op.get("if_primary_term"))
-                        status = 201 if r.result == "created" else 200
-                    else:
-                        r = inst.engine.delete(
-                            op["id"],
-                            if_seq_no=op.get("if_seq_no"),
-                            if_primary_term=op.get("if_primary_term"))
-                        status = 404 if r.result == "not_found" else 200
-                    results.append({"_id": r.doc_id, "_version": r.version,
-                                    "_seq_no": r.seq_no,
-                                    "_primary_term": r.primary_term,
-                                    "result": r.result, "status": status})
-                    if r.result != "not_found":
-                        rep_ops.append({
-                            "op": "delete" if op["op"] == "delete" else "index",
-                            "id": op["id"], "source": op.get("source"),
-                            "seq_no": r.seq_no})
-                except VersionConflictError as e:
-                    results.append({"_id": op["id"], "status": 409,
-                                    "error": e.to_dict()})
-            self._replicate(inst, rep_ops, ops_bytes)
-            inst.tracker.update_local_checkpoint(
-                inst.allocation_id, inst.engine.local_checkpoint)
-            return {"results": results,
-                    "local_checkpoint": inst.engine.local_checkpoint,
-                    "global_checkpoint": inst.tracker.global_checkpoint}
+        try:
+            with self.indexing_pressure.primary(ops_bytes), inst.lock:
+                results: List[dict] = []
+                rep_ops: List[dict] = []
+                for op in p["ops"]:
+                    try:
+                        if op["op"] in ("index", "create"):
+                            r = inst.engine.index(
+                                op["id"], op["source"], op_type=op["op"],
+                                if_seq_no=op.get("if_seq_no"),
+                                if_primary_term=op.get("if_primary_term"))
+                            status = 201 if r.result == "created" else 200
+                        else:
+                            r = inst.engine.delete(
+                                op["id"],
+                                if_seq_no=op.get("if_seq_no"),
+                                if_primary_term=op.get("if_primary_term"))
+                            status = 404 if r.result == "not_found" else 200
+                        results.append({"_id": r.doc_id, "_version": r.version,
+                                        "_seq_no": r.seq_no,
+                                        "_primary_term": r.primary_term,
+                                        "result": r.result, "status": status})
+                        if r.result != "not_found":
+                            rep_ops.append({
+                                "op": "delete" if op["op"] == "delete" else "index",
+                                "id": op["id"], "source": op.get("source"),
+                                "seq_no": r.seq_no})
+                    except VersionConflictError as e:
+                        results.append({"_id": op["id"], "status": 409,
+                                        "error": e.to_dict()})
+                self._replicate(inst, rep_ops, ops_bytes)
+                inst.tracker.update_local_checkpoint(
+                    inst.allocation_id, inst.engine.local_checkpoint)
+                return {"results": results,
+                        "local_checkpoint": inst.engine.local_checkpoint,
+                        "global_checkpoint": inst.tracker.global_checkpoint}
+        except TranslogFsyncError as e:
+            # the WAL could not persist the op: NEVER ack into a broken
+            # translog. Fail this primary copy via the master (promotion /
+            # reallocation follow from apply_failed_shard's reroute) and let
+            # the coordinator retry against the new primary. Reported
+            # outside inst.lock: the state-store applier chain runs
+            # synchronously and re-enters shard locks.
+            _count("fsync_shard_failures")
+            self._report_shard_failed(
+                inst.index, inst.shard_id, inst.allocation_id,
+                f"translog fsync failed: {e}")
+            raise
 
     def _replicate(self, inst: ShardInstance, rep_ops: List[dict],
                    ops_bytes: Optional[int] = None) -> None:
         """Fan one op batch to every assigned copy (ref:
-        ReplicationOperation.java:137 performOnReplicas). In-sync copy
-        failure -> shard-failed to master; a still-recovering copy may miss
-        writes (recovery's finalize gap replay covers it)."""
+        ReplicationOperation.java:137 performOnReplicas). A TRANSIENT
+        transport blip gets exactly one immediate retry; a persistent
+        failure of an in-sync copy -> remove_tracking + shard-failed to the
+        master. A still-recovering copy may miss writes (recovery's finalize
+        gap replay covers it)."""
         if not rep_ops:
             return
         state = self.state
@@ -248,20 +288,32 @@ class DistributedShardService:
             if r.allocation_id == inst.allocation_id:
                 continue
             in_sync = r.allocation_id in inst.tracker.in_sync_ids
+            payload = {"index": inst.index, "shard_id": inst.shard_id,
+                       "primary_term": inst.primary_term, "ops": rep_ops,
+                       "ops_bytes": ops_bytes, "global_checkpoint": gcp}
             try:
-                resp = self.channels.request(
-                    r.node_id, "indices:data/write/bulk[s][r]",
-                    {"index": inst.index, "shard_id": inst.shard_id,
-                     "primary_term": inst.primary_term, "ops": rep_ops,
-                     "ops_bytes": ops_bytes,
-                     "global_checkpoint": gcp})
+                resp = self._replica_request(r.node_id, payload)
                 inst.tracker.update_local_checkpoint(
                     r.allocation_id, resp["local_checkpoint"])
             except Exception as e:  # noqa: BLE001 — any failure fails the copy
                 if in_sync:
                     inst.tracker.remove_tracking(r.allocation_id)
+                    _count("replication_failures")
                     self._report_shard_failed(inst.index, inst.shard_id,
                                               r.allocation_id, str(e))
+
+    def _replica_request(self, node_id: str, payload: dict) -> dict:
+        """One replica-bulk RPC with a single transient retry: a transport
+        blip (channel mid-reconnect, injected `rpc_replica_bulk` fault) must
+        not cost an in-sync copy; anything that fails twice — or fails
+        inside the replica (an application error) — escalates."""
+        try:
+            return self.channels.request(
+                node_id, "indices:data/write/bulk[s][r]", payload)
+        except (NodeUnavailableError, RpcTimeoutError):
+            _count("replication_retries")
+            return self.channels.request(
+                node_id, "indices:data/write/bulk[s][r]", payload)
 
     def _report_shard_failed(self, index: str, shard_id: int,
                              allocation_id: str, reason: str) -> None:
@@ -316,7 +368,11 @@ class DistributedShardService:
     def _on_recovery_segments(self, req) -> dict:
         p = req.payload
         inst = self.get_shard(p["index"], p["shard_id"])
-        payloads, max_seq_no = inst.engine.segment_payloads()
+        with inst.lock:
+            # snapshot under the shard lock so the blob + live mask + the
+            # max_seq_no it is stamped with form one consistent point in
+            # time (a concurrent bulk holds the same lock)
+            payloads, max_seq_no = inst.engine.segment_payloads()
         return {"segments": [
             {"blob": base64.b64encode(blob).decode("ascii"),
              "live": live.tolist()} for blob, live in payloads],
@@ -325,9 +381,36 @@ class DistributedShardService:
     def _on_recovery_ops(self, req) -> dict:
         p = req.payload
         inst = self.get_shard(p["index"], p["shard_id"])
-        ops = inst.engine.changes_since(p["above_seq_no"])
-        return {"ops": ops, "max_seq_no": inst.engine.max_seq_no,
-                "primary_term": inst.primary_term}
+        with inst.lock:
+            # same consistency argument as segments: the op tail and the
+            # max_seq_no / term shipped with it must agree
+            out = {"ops": inst.engine.changes_since(p["above_seq_no"]),
+                   "max_seq_no": inst.engine.max_seq_no,
+                   "primary_term": inst.primary_term}
+            if p.get("divergent"):
+                # a restarted target is rolling its divergent tail back to
+                # the global checkpoint (same machinery as primary-failover
+                # resync): ship the authoritative state of each such doc
+                out["doc_states"] = {d: inst.engine.doc_resync_state(d)
+                                     for d in p["divergent"]}
+            return out
+
+    def _on_recovery_cancel(self, req) -> dict:
+        """A recovery target died or gave up mid-flight: drop its tracking
+        so the global checkpoint is not pinned by a ghost copy forever
+        (ref: RecoverySourceHandler cancel + ReplicationTracker's removal of
+        failed/relocated copies). Idempotent; in-sync copies are never
+        touched — those are the master's to fail."""
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        aid = p["target_allocation_id"]
+        with inst.lock:
+            cleaned = (aid in inst.tracker.tracked_ids
+                       and aid not in inst.tracker.in_sync_ids)
+            if cleaned:
+                inst.tracker.remove_tracking(aid)
+                _count("ghost_cleanups")
+            return {"cleaned": cleaned}
 
     def _on_recovery_finalize(self, req) -> dict:
         p = req.payload
@@ -350,7 +433,11 @@ class DistributedShardService:
     def recover_replica(self, inst: ShardInstance) -> None:
         """Pull-based replica bootstrap from the primary node (ref:
         indices/recovery/PeerRecoveryTargetService.java doRecovery).
-        Raises on failure; caller may retry (every step is idempotent)."""
+        Raises on failure; caller may retry (every step is idempotent).
+
+        Failure after prepare sends a best-effort recovery/cancel to the
+        source so the tracking added for this copy does not linger as a
+        ghost pinning the primary's global checkpoint."""
         state = self.state
         primary = state.primary_of(inst.index, inst.shard_id)
         if primary is None or primary.node_id is None \
@@ -359,28 +446,68 @@ class DistributedShardService:
                 f"no started primary for [{inst.index}][{inst.shard_id}]")
         source = primary.node_id
         shard_ref = {"index": inst.index, "shard_id": inst.shard_id}
+        _count("recoveries_started")
         prep = self.channels.request(
             source, "internal:index/shard/recovery/prepare",
             {**shard_ref, "target_allocation_id": inst.allocation_id,
              "target_node": self.node_name})
+        try:
+            self._recover_replica_tracked(inst, source, shard_ref, prep)
+        except Exception:
+            _count("recoveries_failed")
+            try:
+                self.channels.request(
+                    source, "internal:index/shard/recovery/cancel",
+                    {**shard_ref,
+                     "target_allocation_id": inst.allocation_id})
+            except Exception:  # noqa: BLE001 — best effort; if the source
+                pass           # is gone its tracker died with it
+            raise
+
+    def _recover_replica_tracked(self, inst: ShardInstance, source: str,
+                                 shard_ref: dict, prep: dict) -> None:
+        """The phases that run while the source tracks this copy."""
+        # captured BEFORE phase1: a freshly installed snapshot raises
+        # max_seq_no above the shipped global checkpoint without any
+        # divergence — only pre-existing local history can diverge
+        was_empty = inst.engine.max_seq_no == NO_OPS_PERFORMED
         inst.primary_term = max(inst.primary_term, prep["primary_term"])
         inst.engine.advance_primary_term(prep["primary_term"])
         # phase1 (file phase): install the segment snapshot when this copy
         # is empty — segments are the recovery files
-        if inst.engine.max_seq_no == NO_OPS_PERFORMED:
+        if was_empty:
             seg_resp = self.channels.request(
                 source, "internal:index/shard/recovery/segments", shard_ref)
             for seg in seg_resp["segments"]:
                 inst.engine.install_segment(
                     base64.b64decode(seg["blob"]), seg["live"])
             inst.engine.fill_seqno_gaps(seg_resp["max_seq_no"])
-        # phase2 (ops phase): replay history above what we hold
-        ops_resp = self.channels.request(
-            source, "internal:index/shard/recovery/ops",
-            {**shard_ref, "above_seq_no": inst.engine.local_checkpoint})
-        self._apply_recovery_ops(inst, ops_resp["ops"],
-                                 ops_resp["primary_term"])
-        inst.engine.fill_seqno_gaps(ops_resp["max_seq_no"])
+        if not was_empty \
+                and inst.engine.max_seq_no > prep["global_checkpoint"]:
+            # a restarted copy may hold a divergent tail: ops above the
+            # global checkpoint acked by a deposed primary but absent from
+            # the current one. Roll back to the checkpoint with the SAME
+            # machinery promotion resync uses, then replay forward.
+            gcp = prep["global_checkpoint"]
+            divergent = inst.engine.docs_above(gcp)
+            replay_from = min(gcp, inst.engine.local_checkpoint)
+            ops_resp = self.channels.request(
+                source, "internal:index/shard/recovery/ops",
+                {**shard_ref, "above_seq_no": replay_from,
+                 "divergent": divergent})
+            with inst.lock:
+                resync_target_apply(
+                    inst.engine, prep["primary_term"],
+                    ops_resp.get("doc_states", {}), replay_from,
+                    ops_resp["ops"], ops_resp["max_seq_no"])
+        else:
+            # phase2 (ops phase): replay history above what we hold
+            ops_resp = self.channels.request(
+                source, "internal:index/shard/recovery/ops",
+                {**shard_ref, "above_seq_no": inst.engine.local_checkpoint})
+            self._apply_recovery_ops(inst, ops_resp["ops"],
+                                     ops_resp["primary_term"])
+            inst.engine.fill_seqno_gaps(ops_resp["max_seq_no"])
         # finalize: source marks us in-sync and ships any writes that missed
         # us while we were not yet required
         fin = self.channels.request(

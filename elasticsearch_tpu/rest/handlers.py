@@ -1903,6 +1903,7 @@ class _Handlers:
                 "tpu_turbo": _turbo_merge_stats(),
                 "tpu_health": _tpu_health_stats(),
                 "tpu_coordinator": _tpu_coordinator_stats(),
+                "tpu_durability": _tpu_durability_stats(),
                 "tpu_settings": _tpu_settings_stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
@@ -2226,6 +2227,18 @@ def _tpu_coordinator_stats() -> dict:
     from elasticsearch_tpu.action.search_action import coordinator_stats
 
     return coordinator_stats()
+
+
+def _tpu_durability_stats() -> dict:
+    """Write-path durability section (PR 8): translog fsync failures and
+    syncs, injected corruptions, segment-commit failures, crash-replay
+    counts, replication retries/failures, peer-recovery outcomes, ghost
+    cleanups, and the live async-durability exposure window — one flat
+    section so a chaos run's acked-write accounting is auditable with a
+    single GET."""
+    from elasticsearch_tpu.common.durability import durability_stats
+
+    return durability_stats()
 
 
 def _tpu_settings_stats() -> dict:

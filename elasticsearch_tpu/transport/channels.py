@@ -46,6 +46,17 @@ _RPC_FAULT_SITES = {
     "indices:data/read/search[phase/query]": "rpc_query",
     "indices:data/read/search[phase/fetch/id]": "rpc_fetch",
     "indices:data/read/search[can_match]": "rpc_can_match",
+    "indices:data/write/bulk[s]": "rpc_bulk",
+    "indices:data/write/bulk[s][r]": "rpc_replica_bulk",
+    # every peer-recovery phase shares one site: @nth counts ACROSS the
+    # prepare/segments/ops/finalize/cancel sequence of a recovery
+    "internal:index/shard/recovery/prepare": "rpc_recovery",
+    "internal:index/shard/recovery/segments": "rpc_recovery",
+    "internal:index/shard/recovery/ops": "rpc_recovery",
+    "internal:index/shard/recovery/finalize": "rpc_recovery",
+    "internal:index/shard/recovery/cancel": "rpc_recovery",
+    "internal:index/shard/resync/prepare": "rpc_resync",
+    "internal:index/shard/resync/apply": "rpc_resync",
 }
 
 

@@ -1,0 +1,337 @@
+"""Acked-writes chaos suite (PR 8): deterministic seeded scenarios
+interleaving bulk streams with primary kills, promotions, crash–restarts,
+and injected durability faults.
+
+THE invariant, asserted through the linearizability checker's sequential
+spec (testing/chaos.AckedRegisterSpec): every write the coordinator ACKED
+is durable and readable afterwards. A write that never acked may vanish
+(that is what unacked means); an acked write lost — or a read observing a
+value no linearization explains — fails the history check.
+
+Everything here is synchronous by construction (LocalStateStore drains
+state updates and their deferred recoveries on the submitting thread), so
+the scenarios are deterministic without sleeps or polling; the only
+randomness is the seeded storm generator (ES_TPU_FAULTS_SEED).
+"""
+
+import random
+
+import pytest
+
+from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.common.durability import (
+    durability_stats, reset_for_tests,
+)
+from elasticsearch_tpu.common.faults import inject
+from elasticsearch_tpu.common.settings import knob
+from elasticsearch_tpu.parallel.routing import shard_for_id
+from elasticsearch_tpu.testing.chaos import (
+    AckedWriteHistory, CrashRestartCluster,
+)
+
+pytestmark = pytest.mark.chaos
+
+MAPPINGS = {"properties": {"n": {"type": "integer"},
+                           "body": {"type": "text"}}}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_for_tests()
+    yield
+    faults.clear()
+    reset_for_tests()
+
+
+def make_cluster(tmp_path, n_data=3, shards=1, replicas=1, index="docs",
+                 settings=None):
+    names = ["m0"] + [f"d{i}" for i in range(n_data)]
+    cluster = CrashRestartCluster(names, str(tmp_path),
+                                  roles={"m0": ("master",)})
+    body = {"settings": {"number_of_shards": shards,
+                         "number_of_replicas": replicas,
+                         **(settings or {})},
+            "mappings": MAPPINGS}
+    cluster.master().create_index(index, body)
+    return cluster
+
+
+def acked_bulk(cluster, history, ops, index="docs", **kw):
+    """Run one coordinator bulk, recording invoke/ack per op in the
+    history. Returns the set of doc ids that were acked."""
+    # the register value is the doc's `n` field (hashable, and what the
+    # final reads observe)
+    pending = [(op, history.invoke(op["id"],
+                                   "delete" if op["op"] == "delete"
+                                   else "write",
+                                   (op.get("source") or {}).get("n")))
+               for op in ops]
+    resp = cluster.master().bulk(index, list(ops), **kw)
+    acked = set()
+    for (op, op_id), item in zip(pending, resp["items"]):
+        if item is not None and "error" not in item:
+            history.respond(op["id"], op_id)
+            acked.add(op["id"])
+    return acked
+
+
+def write_op(doc_id, value):
+    return {"op": "index", "id": doc_id,
+            "source": {"n": value, "body": f"v{value}"}}
+
+
+def final_reads(cluster, history, doc_ids, index="docs"):
+    for d in sorted(doc_ids):
+        src = cluster.read_doc(index, d)
+        history.record_read(d, None if src is None else src["n"])
+
+
+def node_of_copy(cluster, index, sid, primary):
+    for r in cluster.store.current().shard_copies(index, sid):
+        if r.primary == primary and r.node_id is not None \
+                and r.state == "STARTED":
+            return r.node_id
+    return None
+
+
+# --------------------------------------------------------------- scenarios
+
+
+def test_primary_kill_mid_bulk_stream(tmp_path):
+    """Scenario 1: the primary dies between bulks; promotion + the
+    coordinator's stale-routing retry keep every acked write readable."""
+    cluster = make_cluster(tmp_path)
+    history = AckedWriteHistory()
+    docs = [f"doc{i}" for i in range(8)]
+    acked_bulk(cluster, history, [write_op(d, 1) for d in docs])
+    victim = node_of_copy(cluster, "docs", 0, primary=True)
+    cluster.crash(victim)
+    acked_bulk(cluster, history, [write_op(d, 2) for d in docs])
+    final_reads(cluster, history, docs)
+    assert history.check() == []
+
+
+def test_kill_during_recovery_finalize_cleans_ghost(tmp_path):
+    """Scenario 2: the recovery RPC sequence dies at finalize (@4 across
+    prepare/segments/ops/finalize); the target cancels its tracking on the
+    source (no ghost pinning the global checkpoint) and the retry brings
+    the copy in-sync."""
+    cluster = make_cluster(tmp_path)
+    history = AckedWriteHistory()
+    docs = [f"doc{i}" for i in range(6)]
+    acked_bulk(cluster, history, [write_op(d, 1) for d in docs])
+    replica_holder = node_of_copy(cluster, "docs", 0, primary=False)
+    with inject("rpc_recovery:raise@4x1"):
+        # the crash triggers reallocation + the (faulted) recovery, all
+        # synchronously inside report_node_left
+        cluster.crash(replica_holder)
+    stats = durability_stats()
+    assert stats["ghost_cleanups"] == 1
+    assert stats["recoveries_failed"] >= 1
+    assert stats["recoveries_retried"] >= 1
+    inst = cluster.primary_instance("docs", docs[0])
+    assert inst.tracker.tracked_ids == inst.tracker.in_sync_ids
+    assert len(inst.tracker.in_sync_ids) == 2   # primary + recovered copy
+    acked_bulk(cluster, history, [write_op(d, 2) for d in docs])
+    final_reads(cluster, history, docs)
+    assert history.check() == []
+
+
+def test_fsync_fault_fails_shard_never_acks_broken_wal(tmp_path):
+    """Scenario 3: a translog fsync fault on the primary fails the copy via
+    the master (promotion + reallocation, no wedged shard) and the
+    coordinator's retry lands the write on the NEW primary — the broken
+    WAL never acked anything."""
+    cluster = make_cluster(tmp_path)
+    history = AckedWriteHistory()
+    docs = [f"doc{i}" for i in range(4)]
+    acked_bulk(cluster, history, [write_op(d, 1) for d in docs])
+    old_primary = node_of_copy(cluster, "docs", 0, primary=True)
+    with inject("translog_fsync:raise@1x1"):
+        acked = acked_bulk(cluster, history, [write_op("k", 9)])
+    assert acked == {"k"}                      # retried onto the new primary
+    stats = durability_stats()
+    assert stats["fsync_shard_failures"] == 1
+    assert stats["fsync_failures"] >= 1
+    new_primary = node_of_copy(cluster, "docs", 0, primary=True)
+    assert new_primary != old_primary          # the master reallocated
+    final_reads(cluster, history, docs + ["k"])
+    assert history.check() == []
+
+
+def test_fsync_fault_visible_in_nodes_stats_section(tmp_path):
+    """Scenario 3b: the tpu_durability stats section carries the ladder's
+    counters (same helper GET /_nodes/stats renders)."""
+    from elasticsearch_tpu.rest.handlers import _tpu_durability_stats
+
+    cluster = make_cluster(tmp_path)
+    with inject("translog_fsync:raise@1x1"):
+        cluster.master().bulk("docs", [write_op("k", 1)])
+    out = _tpu_durability_stats()
+    for key in ("fsync_failures", "fsync_shard_failures", "translog_syncs",
+                "replication_retries", "recoveries_started",
+                "ghost_cleanups", "open_translogs", "max_ops_since_sync"):
+        assert key in out
+    assert out["fsync_shard_failures"] == 1
+    assert out["translog_syncs"] > 0
+
+
+def test_crash_restart_replays_translog(tmp_path):
+    """Scenario 4: a single-copy node crashes before any flush and comes
+    back from disk: the commit load + translog replay restore every acked
+    write (the master never noticed — report=False models a fast restart)."""
+    cluster = make_cluster(tmp_path, n_data=1, replicas=0)
+    history = AckedWriteHistory()
+    docs = [f"doc{i}" for i in range(10)]
+    acked_bulk(cluster, history, [write_op(d, 7) for d in docs])
+    cluster.crash("d0", report=False)
+    cluster.restart("d0")
+    assert durability_stats()["translog_replays"] >= 1
+    final_reads(cluster, history, docs)
+    assert history.check() == []
+
+
+def test_segment_commit_fault_then_crash_restart(tmp_path):
+    """Scenario 5: flush dies at the segment_commit site, leaving the docs
+    translog-only; a crash + restart still recovers them — the WAL covers
+    everything the failed commit did not."""
+    cluster = make_cluster(tmp_path, n_data=1, replicas=0)
+    history = AckedWriteHistory()
+    docs = [f"doc{i}" for i in range(5)]
+    acked_bulk(cluster, history, [write_op(d, 3) for d in docs])
+    inst = cluster.node("d0").shard_service.shards[("docs", 0)]
+    with inject("segment_commit:raise@1x1"):
+        with pytest.raises(OSError):
+            inst.engine.flush()
+    assert durability_stats()["segment_commit_failures"] == 1
+    cluster.crash("d0", report=False)
+    cluster.restart("d0")
+    final_reads(cluster, history, docs)
+    assert history.check() == []
+
+
+def test_async_durability_exposure_is_bounded(tmp_path, monkeypatch):
+    """Scenario 6: under async durability a crash may lose the unsynced
+    tail — but never more than ES_TPU_TRANSLOG_SYNC_OPS ops of it."""
+    monkeypatch.setenv("ES_TPU_TRANSLOG_SYNC_OPS", "4")
+    cluster = make_cluster(
+        tmp_path, n_data=1, replicas=0,
+        settings={"index.translog.durability": "async"})
+    docs = [f"doc{i}" for i in range(10)]
+    for d in docs:
+        cluster.master().bulk("docs", [write_op(d, 5)])
+    # 10 appends with a window of 4: synced through op 8; ops 9-10 exposed
+    assert durability_stats()["max_ops_since_sync"] <= 4
+    cluster.crash("d0", report=False)
+    cluster.restart("d0")
+    survived = [d for d in docs
+                if cluster.read_doc("docs", d) is not None]
+    assert len(survived) >= len(docs) - 4
+    assert survived == docs[:len(survived)]    # a PREFIX: no holes
+
+
+def test_promotion_under_divergence_rolls_back_restarted_copy(tmp_path):
+    """Scenario 7: the primary dies holding a durable-but-unreplicated
+    tail; the replica is promoted; the restarted old primary must roll its
+    divergent tail back to the promoted primary's history (recovery reuses
+    the resync machinery) — reads never resurrect the unacked value."""
+    cluster = make_cluster(tmp_path, n_data=2)
+    history = AckedWriteHistory()
+    acked_bulk(cluster, history, [write_op("k", 1)])
+    old_primary = node_of_copy(cluster, "docs", 0, primary=True)
+    inst = cluster.node(old_primary).shard_service.shards[("docs", 0)]
+    # a write that reached (and fsynced on) the primary but never
+    # replicated and never acked: invoke with no response
+    history.invoke("k", "write", 2)
+    with inst.lock:
+        inst.engine.index("k", {"n": 2, "body": "v2"})
+    cluster.crash(old_primary)                 # replica promoted
+    restarted = cluster.restart(old_primary)   # rejoins as replica
+    sid = shard_for_id("k", 1)
+    r_inst = restarted.shard_service.shards[("docs", sid)]
+    assert r_inst.engine.get("k")["_source"]["n"] == 1   # tail rolled back
+    acked_bulk(cluster, history, [write_op("k", 3)])
+    assert r_inst.engine.get("k")["_source"]["n"] == 3   # replication works
+    final_reads(cluster, history, ["k"])
+    assert history.check() == []
+
+
+def test_replica_bulk_transient_blip_is_retried(tmp_path):
+    """Scenario 8: one injected replica-RPC blip costs a retry, not the
+    copy — the replica stays in-sync and holds the write."""
+    cluster = make_cluster(tmp_path)
+    history = AckedWriteHistory()
+    acked_bulk(cluster, history, [write_op("a", 1)])
+    replica_holder = node_of_copy(cluster, "docs", 0, primary=False)
+    with inject(f"rpc_replica_bulk#{replica_holder}:raise@1x1"):
+        acked_bulk(cluster, history, [write_op("b", 1)])
+    stats = durability_stats()
+    assert stats["replication_retries"] == 1
+    assert stats["replication_failures"] == 0
+    inst = cluster.primary_instance("docs", "b")
+    assert len(inst.tracker.in_sync_ids) == 2  # still in-sync
+    r_inst = cluster.node(replica_holder).shard_service.shards[("docs", 0)]
+    assert r_inst.engine.get("b") is not None
+    final_reads(cluster, history, ["a", "b"])
+    assert history.check() == []
+
+
+def test_replica_bulk_persistent_failure_fails_copy_not_acks(tmp_path):
+    """Scenario 9: a persistently unreachable replica is failed to the
+    master after the one transient retry; the write still acks (the
+    primary + reallocated copy carry it) and no acked write is lost."""
+    cluster = make_cluster(tmp_path)
+    history = AckedWriteHistory()
+    acked_bulk(cluster, history, [write_op("a", 1)])
+    replica_holder = node_of_copy(cluster, "docs", 0, primary=False)
+    with inject(f"rpc_replica_bulk#{replica_holder}:raise@1xinf"):
+        acked = acked_bulk(cluster, history, [write_op("b", 1)])
+    assert acked == {"b"}
+    stats = durability_stats()
+    assert stats["replication_retries"] >= 1
+    assert stats["replication_failures"] == 1
+    # the faulted copy was removed and a replacement recovered in-sync
+    inst = cluster.primary_instance("docs", "b")
+    assert len(inst.tracker.in_sync_ids) == 2
+    acked_bulk(cluster, history, [write_op("c", 1)])
+    final_reads(cluster, history, ["a", "b", "c"])
+    assert history.check() == []
+
+
+def test_seeded_chaos_storm(tmp_path):
+    """Scenario 10: the storm — seeded random interleaving of bulk
+    streams, primary/replica kills, restarts, and bounded durability
+    faults across a 2-shard/1-replica index. Deterministic under
+    ES_TPU_FAULTS_SEED; zero acked-write loss, every final read
+    linearizable."""
+    seed = knob("ES_TPU_FAULTS_SEED") or 8
+    rng = random.Random(seed)
+    cluster = make_cluster(tmp_path, n_data=3, shards=2, replicas=1)
+    history = AckedWriteHistory()
+    keyspace = [f"doc{i}" for i in range(12)]
+    value = 0
+    down = None
+    for rnd in range(8):
+        value += 1
+        batch = [write_op(d, value)
+                 for d in rng.sample(keyspace, rng.randint(3, 8))]
+        if rnd in (2, 5):
+            spec = rng.choice(["translog_fsync:raise@1x1",
+                               "rpc_replica_bulk:raise@1x1"])
+            with inject(spec):
+                acked_bulk(cluster, history, batch)
+        else:
+            acked_bulk(cluster, history, batch)
+        if rnd in (1, 4) and down is None:
+            down = rng.choice(sorted(
+                n.node_name for n in cluster.nodes
+                if n.node_name != "m0"))
+            cluster.crash(down)
+        elif down is not None:
+            cluster.restart(down)
+            down = None
+    if down is not None:
+        cluster.restart(down)
+    final_reads(cluster, history, keyspace)
+    assert history.check() == []
+    assert durability_stats()["recoveries_started"] >= 1

@@ -1,0 +1,222 @@
+"""Write-path fault ladder units (PR 8): grammar coverage for the new
+sites, translog fsync/corruption behavior, the async-durability exposure
+bound, the engine's failed-state latch, replication retry classification,
+and the knob surface backing the coordinator bulk retry loop.
+"""
+
+import os
+
+import pytest
+
+from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.common.durability import (
+    durability_stats, reset_for_tests,
+)
+from elasticsearch_tpu.common.faults import (
+    DURABILITY_SITES, DurabilityFaultError, FaultSpecError, corruption_fires,
+    durability_fault_point, inject, parse_spec, transport_fault_point,
+)
+from elasticsearch_tpu.common.settings import ENV_KNOBS, knob
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.index.translog import (
+    Translog, TranslogCorruptedError, TranslogFsyncError,
+)
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.transport.channels import (
+    _RPC_FAULT_SITES, NodeUnavailableError,
+)
+
+pytestmark = pytest.mark.faults
+
+MAPPING = {"properties": {"body": {"type": "text"},
+                          "n": {"type": "long"}}}
+
+NEW_SITES = ("rpc_bulk", "rpc_replica_bulk", "rpc_recovery", "rpc_resync",
+             "translog_fsync", "translog_corrupt", "segment_commit")
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    reset_for_tests()
+    yield
+    faults.clear()
+    reset_for_tests()
+
+
+def make_engine(path=None):
+    return InternalEngine(MapperService(dict(MAPPING)), data_path=path)
+
+
+# ---------------------------------------------------------------- grammar
+
+
+def test_all_new_sites_parse():
+    spec = ";".join(f"{s}:raise" for s in NEW_SITES)
+    clauses = parse_spec(spec)
+    assert [c.site for c in clauses] == list(NEW_SITES)
+
+
+def test_rpc_bulk_accepts_node_name_part():
+    (c,) = parse_spec("rpc_bulk#d1:raise@2x3")
+    assert (c.site, c.part, c.nth, c.count) == ("rpc_bulk", "d1", 2, 3)
+
+
+def test_durability_site_rejects_node_name_part():
+    # durability sites take integer parts only — a node-name selector on
+    # translog_fsync is a spec typo, and typos fail LOUD
+    with pytest.raises(FaultSpecError):
+        parse_spec("translog_fsync#x:raise")
+
+
+def test_translog_fsync_nth_count_markers():
+    (c,) = parse_spec("translog_fsync:raise@2x3")
+    assert (c.nth, c.count) == (2, 3)
+    assert faults._fire_mode("translog_fsync", None) is None  # call 1
+    faults.install("translog_fsync:raise@2x3")
+    try:
+        hits = [faults._fire_mode("translog_fsync", None) is not None
+                for _ in range(6)]
+        assert hits == [False, True, True, True, False, False]
+    finally:
+        faults.clear()
+
+
+def test_every_write_rpc_action_maps_to_a_site():
+    for action, site in {
+            "indices:data/write/bulk[s]": "rpc_bulk",
+            "indices:data/write/bulk[s][r]": "rpc_replica_bulk",
+            "internal:index/shard/recovery/prepare": "rpc_recovery",
+            "internal:index/shard/recovery/segments": "rpc_recovery",
+            "internal:index/shard/recovery/ops": "rpc_recovery",
+            "internal:index/shard/recovery/finalize": "rpc_recovery",
+            "internal:index/shard/recovery/cancel": "rpc_recovery",
+            "internal:index/shard/resync/prepare": "rpc_resync",
+            "internal:index/shard/resync/apply": "rpc_resync"}.items():
+        assert _RPC_FAULT_SITES[action] == site
+
+
+# ------------------------------------------------------------------ fire
+
+
+def test_durability_fault_point_fires_as_oserror():
+    with inject("translog_fsync:raise@1x1"):
+        with pytest.raises(DurabilityFaultError) as ei:
+            durability_fault_point("translog_fsync")
+        assert isinstance(ei.value, OSError)
+        durability_fault_point("translog_fsync")  # x1 consumed
+
+
+def test_transport_site_fires_node_unavailable():
+    with inject("rpc_bulk#d1:raise@1x1"):
+        transport_fault_point("rpc_bulk", "d2")  # wrong node: no fire
+        with pytest.raises(NodeUnavailableError):
+            transport_fault_point("rpc_bulk", "d1")
+
+
+def test_corruption_fires_is_consumable():
+    with inject("translog_corrupt:raise@1x1"):
+        assert corruption_fires() is True
+        assert corruption_fires() is False
+
+
+# -------------------------------------------------------------- translog
+
+
+def test_fsync_fault_raises_and_counts(tmp_path):
+    t = Translog(str(tmp_path / "t"))
+    t.add({"op": "index", "id": "a", "seq_no": 0})
+    with inject("translog_fsync:raise@1x1"):
+        with pytest.raises(TranslogFsyncError):
+            t.add({"op": "index", "id": "b", "seq_no": 1})
+    assert durability_stats()["fsync_failures"] == 1
+    # the site recovered: the next append syncs fine
+    t.add({"op": "index", "id": "c", "seq_no": 2})
+
+
+def test_async_durability_window_is_bounded(tmp_path, monkeypatch):
+    monkeypatch.setenv("ES_TPU_TRANSLOG_SYNC_OPS", "4")
+    t = Translog(str(tmp_path / "t"), durability="async")
+    for i in range(3):
+        t.add({"op": "index", "id": str(i), "seq_no": i})
+    assert t.ops_since_sync == 3
+    assert durability_stats()["max_ops_since_sync"] == 3
+    t.add({"op": "index", "id": "3", "seq_no": 3})  # hits the bound
+    assert t.ops_since_sync == 0
+
+
+def test_interior_corruption_surfaces_at_replay(tmp_path):
+    t = Translog(str(tmp_path / "t"))
+    with inject("translog_corrupt:raise@1x1"):
+        t.add({"op": "index", "id": "a", "seq_no": 0})  # written, CRC broken
+    t.add({"op": "index", "id": "b", "seq_no": 1})      # makes it interior
+    assert durability_stats()["translog_corruptions"] == 1
+    with pytest.raises(TranslogCorruptedError):
+        list(t.read_ops())
+
+
+def test_corrupt_tail_record_is_a_torn_write(tmp_path):
+    t = Translog(str(tmp_path / "t"))
+    t.add({"op": "index", "id": "a", "seq_no": 0})
+    with inject("translog_corrupt:raise@1x1"):
+        t.add({"op": "index", "id": "b", "seq_no": 1})  # last record
+    ops = list(t.read_ops())
+    assert [op["id"] for op in ops] == ["a"]
+
+
+# ---------------------------------------------------------------- engine
+
+
+def test_engine_latches_failed_after_fsync_fault(tmp_path):
+    e = make_engine(str(tmp_path / "s"))
+    e.index("a", {"body": "x", "n": 1})
+    with inject("translog_fsync:raise@1x1"):
+        with pytest.raises(TranslogFsyncError):
+            e.index("b", {"body": "y", "n": 2})
+    assert e.failed_reason is not None
+    # the latch holds after the fault clears: a failed copy must be
+    # reallocated, never written into
+    with pytest.raises(TranslogFsyncError):
+        e.index("c", {"body": "z", "n": 3})
+
+
+def test_segment_commit_fault_counts_and_raises(tmp_path):
+    e = make_engine(str(tmp_path / "s"))
+    e.index("a", {"body": "x", "n": 1})
+    with inject("segment_commit:raise@1x1"):
+        with pytest.raises(OSError):
+            e.flush()
+    assert durability_stats()["segment_commit_failures"] == 1
+    e.flush()  # recovered
+
+
+def test_recover_from_disk_counts_replays(tmp_path):
+    path = str(tmp_path / "s")
+    e1 = make_engine(path)
+    e1.index("a", {"body": "x", "n": 1})
+    e1.index("b", {"body": "y", "n": 2})
+    # no flush: a second engine over the same path replays the WAL
+    e2 = make_engine(path)
+    assert e2.get("a") is not None and e2.get("b") is not None
+    stats = durability_stats()
+    assert stats["translog_replays"] >= 1
+    assert stats["translog_replayed_ops"] >= 2
+    del e1  # keep the first engine alive until after the replay check
+
+
+# ----------------------------------------------------------------- knobs
+
+
+def test_write_path_knobs_are_declared():
+    for name, default in (("ES_TPU_TRANSLOG_SYNC_OPS", 128),
+                          ("ES_TPU_BULK_RETRIES", 20),
+                          ("ES_TPU_BULK_RETRY_MS", 100),
+                          ("ES_TPU_BULK_TIMEOUT_MS", 0),
+                          ("ES_TPU_RECOVERY_RETRIES", 3),
+                          ("ES_TPU_RECOVERY_BACKOFF_MS", 50)):
+        assert name in ENV_KNOBS
+        if os.environ.get(name) in (None, ""):
+            assert knob(name) == default
+
+
+def test_durability_sites_are_known():
+    assert DURABILITY_SITES <= faults.KNOWN_SITES
