@@ -1059,6 +1059,82 @@ def dryrun_chaos() -> int:
     return 0 if ok else 1
 
 
+def dryrun_trace() -> int:
+    """Flight-recorder smoke (PR 9): single-node CPU run asserting the
+    observability loop end to end — a profiled search returns a
+    `profile.tpu` phase breakdown with a trace id, the `tpu_search_latency`
+    histograms in `_nodes/stats` moved, and a query over a 0ms slowlog
+    threshold lands in GET /_tpu/slowlog carrying the same trace id. One
+    JSON line on stdout; exit 0/1."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.common import metrics, tracing
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import RestController, register_handlers
+
+    metrics.reset_for_tests()
+    tracing.reset_for_tests()
+    log("dryrun_trace: starting single-node REST smoke...")
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+
+    def call(method, path, body=None, params=None, headers=None):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body)
+        return rc.dispatch(method, path, params or {}, body,
+                           headers=headers)
+
+    try:
+        call("PUT", "/flight", {
+            "settings": {"index": {"search": {"slowlog": {"threshold": {
+                "query": {"warn": "0ms"}}}}}},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        # enough docs that from+size=10 stays fast-path servable
+        # (_disj_servable requires k <= max partition doc count)
+        for i in range(32):
+            call("PUT", f"/flight/_doc/{i}",
+                 {"body": f"hello world doc{i}"})
+        call("POST", "/flight/_refresh")
+        r = call("POST", "/flight/_search",
+                 {"query": {"match": {"body": "hello"}}, "profile": True},
+                 headers={"X-Opaque-Id": "dryrun-trace"})
+        prof = (r.body or {}).get("profile") or {}
+        tpu = prof.get("tpu") or {}
+        trace_id = tpu.get("trace_id")
+        phases = tpu.get("phases") or {}
+        stats = call("GET", "/_nodes/stats").body
+        lat = next(iter(stats["nodes"].values()))["tpu_search_latency"]
+        slow = call("GET", "/_tpu/slowlog").body
+        slow_ids = [e.get("trace_id") for e in slow.get("slowlog", [])]
+    finally:
+        node.close()
+    ok = (r.status == 200
+          and bool(trace_id)
+          and tpu.get("opaque_id") == "dryrun-trace"
+          and {"device", "demux", "fetch"} <= set(phases)
+          and lat["rest_total"]["count"] >= 1
+          and lat["device"]["count"] >= 1
+          and lat["fetch"]["count"] >= 1
+          and lat["slowlog"]["query_warn"] >= 1
+          and trace_id in slow_ids)
+    print(json.dumps({
+        "metric": "dryrun_trace",
+        "ok": bool(ok),
+        "trace_id": trace_id,
+        "phases": sorted(phases),
+        "rest_total_count": int(lat["rest_total"]["count"]),
+        "device_count": int(lat["device"]["count"]),
+        "fetch_count": int(lat["fetch"]["count"]),
+        "slowlog_query_warn": int(lat["slowlog"]["query_warn"]),
+        "slowlog_has_trace": bool(trace_id in slow_ids),
+    }), flush=True)
+    log(f"dryrun_trace: trace_id={trace_id} phases={sorted(phases)}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -1072,4 +1148,7 @@ if __name__ == "__main__":
     if "dryrun_chaos" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_chaos":
         sys.exit(dryrun_chaos())
+    if "dryrun_trace" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_trace":
+        sys.exit(dryrun_trace())
     main()

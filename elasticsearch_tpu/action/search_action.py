@@ -44,6 +44,7 @@ from elasticsearch_tpu.common.errors import (
     SearchPhaseExecutionError,
 )
 from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.common import metrics, tracing
 from elasticsearch_tpu.common.settings import knob
 from elasticsearch_tpu.indices.shard_service import DistributedShardService
 from elasticsearch_tpu.search.fetch_phase import execute_fetch_phase
@@ -320,7 +321,41 @@ class SearchActionService:
             inst._serving_ctx = ctx
         return ctx
 
+    def _shard_slowlog(self, phase: str, index: str, shard_id, took_ms: float,
+                       body: dict, tc) -> None:
+        """Data-node slowlog: check this shard's phase timing against the
+        index's effective thresholds (cluster-state settings) and append a
+        structured record when over."""
+        meta = self.shards.state.indices.get(index)
+        if meta is None:
+            return
+        th = tracing.slowlog_thresholds(meta.settings).get(phase) or {}
+        level = tracing.slowlog_check(phase, took_ms, th)
+        if level is not None:
+            tracing.slowlog_record(
+                phase, level, index, took_ms,
+                source=body.get("query"), node=self.shards.node_name,
+                shard=shard_id, tc=tc)
+
     def _on_shard_query(self, req) -> dict:
+        p = req.payload
+        tc = tracing.child_from_wire(p.get("_trace"),
+                                     node=self.shards.node_name,
+                                     kind="shard_query")
+        t0 = time.monotonic()
+        with tracing.activate(tc):
+            out = self._shard_query_inner(req)
+        q_ms = (time.monotonic() - t0) * 1e3
+        metrics.observe("query", q_ms)
+        if tc is not None:
+            tc.add_span("query", q_ms, index=p["index"], shard=p["shard_id"])
+            tracing.record_trace(tc)
+            out["_trace_spans"] = tc.span_dicts()
+        self._shard_slowlog("query", p["index"], p["shard_id"], q_ms,
+                            p["body"], tc)
+        return out
+
+    def _shard_query_inner(self, req) -> dict:
         p = req.payload
         inst = self.shards.get_shard(p["index"], p["shard_id"])
         searcher = inst.engine.acquire_searcher()
@@ -371,14 +406,27 @@ class SearchActionService:
 
     def _on_shard_fetch(self, req) -> dict:
         p = req.payload
+        tc = tracing.child_from_wire(p.get("_trace"),
+                                     node=self.shards.node_name,
+                                     kind="shard_fetch")
         ctx = self.contexts.get(p["context_id"])
         hits = [ShardHit(leaf_idx=h["leaf_idx"], ord=h["ord"],
                          score=h["score"], global_ord=h["global_ord"],
                          sort_values=h.get("sort_values"))
                 for h in p["hits"]]
-        fetched = execute_fetch_phase(ctx.searcher, hits, p["body"],
-                                      ctx.index, mapper=ctx.mapper)
-        return {"hits": fetched}
+        t0 = time.monotonic()
+        with tracing.activate(tc):
+            fetched = execute_fetch_phase(ctx.searcher, hits, p["body"],
+                                          ctx.index, mapper=ctx.mapper)
+        f_ms = (time.monotonic() - t0) * 1e3
+        metrics.observe("fetch", f_ms)
+        out = {"hits": fetched}
+        if tc is not None:
+            tc.add_span("fetch", f_ms, index=ctx.index, hits=len(hits))
+            tracing.record_trace(tc)
+            out["_trace_spans"] = tc.span_dicts()
+        self._shard_slowlog("fetch", ctx.index, None, f_ms, p["body"], tc)
+        return out
 
     def _on_free_context(self, req) -> dict:
         freed = self.contexts.release(req.payload["context_id"])
@@ -585,12 +633,17 @@ class SearchActionService:
             if attempted:
                 _count_coord("shard_retries")
             attempted.append(node)
+            tc = tracing.current()
+            payload = {"index": target.index, "shard_id": target.sid,
+                       "body": self._shard_body(body, deadline)}
+            if tc is not None:
+                # per-attempt propagation: every failover retry shares the
+                # SAME trace id, so a recovered request shows both the
+                # failed and the successful rpc_query span
+                payload["_trace"] = tc.wire()
             t_q = time.monotonic()
             try:
-                resp = self._rpc(
-                    node, ACTION_QUERY,
-                    {"index": target.index, "shard_id": target.sid,
-                     "body": self._shard_body(body, deadline)}, deadline)
+                resp = self._rpc(node, ACTION_QUERY, payload, deadline)
             except CircuitBreakingError:
                 # a breaker trip is a REQUEST error, not a shard failure —
                 # swallowing it would return silently-wrong aggregations
@@ -598,11 +651,21 @@ class SearchActionService:
                 raise
             except Exception as e:  # noqa: BLE001 — failover candidate
                 last_err = e
+                if tc is not None:
+                    tc.add_span("rpc_query", (time.monotonic() - t_q) * 1e3,
+                                node=node, index=target.index,
+                                shard=target.sid, attempt=len(attempted),
+                                error=type(e).__name__)
                 self._penalize_node(node)
                 self._record_transport_outcome(node, e)
                 return None
             self._record_transport_outcome(node)
-            self._note_node_ok(node, (time.monotonic() - t_q) * 1000.0)
+            rpc_ms = (time.monotonic() - t_q) * 1000.0
+            self._note_node_ok(node, rpc_ms)
+            if tc is not None:
+                tc.add_span("rpc_query", rpc_ms, node=node,
+                            index=target.index, shard=target.sid,
+                            attempt=len(attempted))
             resp["_node"] = node
             resp["_index"] = target.index
             resp["_shard"] = target.sid
@@ -637,11 +700,42 @@ class SearchActionService:
                                          last_err, "query",
                                          attempted=attempted)
 
+    def _should_trace(self, body: dict,
+                      state: Optional[ClusterState]) -> bool:
+        """Coordinator-side trace enablement: profile requests, every-Nth
+        sampling, or a slowlog threshold configured on any target index
+        (slow queries must carry phase attribution)."""
+        if body.get("profile"):
+            return True
+        if tracing.should_sample():
+            return True
+        st = state or self.shards.state
+        for meta in st.indices.values():
+            if tracing.slowlog_configured(meta.settings):
+                return True
+        return False
+
     def execute_search(self, index_expr: str, body: dict,
                        state: Optional[ClusterState] = None) -> dict:
         """query_then_fetch across every target shard's best copy, with
         replica failover, deadline propagation, and partial-results
-        accounting (see module docstring)."""
+        accounting (see module docstring). Wraps the phase runner in a
+        coordinator TraceContext when the flight recorder is on (an
+        already-active trace — the REST layer's — is reused as-is)."""
+        tc = tracing.current()
+        if tc is not None:
+            return self._execute_search_phases(index_expr, body, state)
+        if not self._should_trace(body, state):
+            return self._execute_search_phases(index_expr, body, state)
+        tc = tracing.TraceContext(node=self.shards.node_name,
+                                  kind="coordinator")
+        with tracing.activate(tc):
+            resp = self._execute_search_phases(index_expr, body, state)
+        tracing.record_trace(tc)
+        return resp
+
+    def _execute_search_phases(self, index_expr: str, body: dict,
+                               state: Optional[ClusterState] = None) -> dict:
         from elasticsearch_tpu.tasks.task_manager import (
             Deadline, parse_timeout_ms,
         )
@@ -763,7 +857,13 @@ class SearchActionService:
             # ---- reduce (ref: SearchPhaseController.reducedQueryPhase) ----
             # the incremental consumer already merged/deduped/truncated as
             # results arrived; finish() folds any remainder
+            t_merge = time.monotonic()
             window_entries, agg_state = consumer.finish()
+            merge_ms = (time.monotonic() - t_merge) * 1e3
+            metrics.observe("merge", merge_ms)
+            tc = tracing.current()
+            if tc is not None:
+                tc.add_span("merge", merge_ms, shards=len(shard_results))
 
             window = [(si, h, shard_results[si])
                       for si, h in window_entries][from_: from_ + size]
@@ -787,12 +887,21 @@ class SearchActionService:
                             "request timeout expired before the fetch "
                             "phase"), "fetch"))
                     continue
+                fetch_payload = {"context_id": r["context_id"],
+                                 "hits": hits, "body": body}
+                tc_f = tracing.current()
+                if tc_f is not None:
+                    fetch_payload["_trace"] = tc_f.wire()
+                t_f = time.monotonic()
                 try:
-                    resp = self._rpc(
-                        node, ACTION_FETCH,
-                        {"context_id": r["context_id"], "hits": hits,
-                         "body": body}, deadline)
+                    resp = self._rpc(node, ACTION_FETCH, fetch_payload,
+                                     deadline)
                     self._record_transport_outcome(node)
+                    if tc_f is not None:
+                        tc_f.add_span("rpc_fetch",
+                                      (time.monotonic() - t_f) * 1e3,
+                                      node=node, index=r["_index"],
+                                      shard=r["_shard"], hits=len(hits))
                 except CircuitBreakingError:
                     raise
                 except Exception as e:  # noqa: BLE001 — drop one shard
@@ -870,11 +979,29 @@ class SearchActionService:
 
         profile = None
         if body.get("profile"):
-            profile = {"shards": [
-                {"id": f"[{r['_index']}][{r['_shard']}]",
-                 "searches": [{"query": r.get("profile") or [],
-                               "rewrite_time": 0, "collector": []}]}
-                for r in shard_results]}
+            shards_prof = []
+            for r in shard_results:
+                entry = {"id": f"[{r['_index']}][{r['_shard']}]",
+                         "searches": [{"query": r.get("profile") or [],
+                                       "rewrite_time": 0, "collector": []}]}
+                spans = r.get("_trace_spans")
+                if spans:
+                    phases: Dict[str, float] = {}
+                    for s in spans:
+                        phases[s["name"]] = round(
+                            phases.get(s["name"], 0.0) + s["duration_ms"], 3)
+                    entry["tpu"] = {"node": r["_node"], "phases": phases,
+                                    "spans": spans}
+                shards_prof.append(entry)
+            profile = {"shards": shards_prof}
+            tc_p = tracing.current()
+            if tc_p is not None:
+                # took decomposition: coordinator-side phase totals (rpc
+                # fan-out, reduce) keyed by the shared trace id
+                profile["tpu"] = {"trace_id": tc_p.trace_id,
+                                  "opaque_id": tc_p.opaque_id,
+                                  "node": self.shards.node_name,
+                                  "phases": tc_p.phase_totals()}
         if deadline is not None and deadline.expired:
             timed_out = True
         shards_section = {

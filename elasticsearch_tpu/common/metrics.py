@@ -1,0 +1,209 @@
+"""Log-bucketed latency histograms for the search flight recorder.
+
+Node-wide distributions per search phase (queue wait, coalesce wait, device
+sweep, demux, fetch, ...) plus coalescer batch-size / pad-ratio shapes.
+Design constraints:
+
+- **Fixed bucket boundaries** per kind so histograms merge across nodes by
+  summing bucket counts (no per-node rescaling; see ``merge_summaries``).
+- **Always-on and cheap**: one bisect + three integer bumps under a lock per
+  observation. Span recording (tracing.py) is the gated/off-by-default part;
+  histograms are the standing node-level distributions.
+- Every histogram name must be declared here via ``declare_histogram`` so
+  tpulint TPU005 can verify observation sites against the registry and the
+  whole set surfaces in ``search_latency_stats()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional, Tuple
+
+
+def _log_ms_bounds() -> Tuple[float, ...]:
+    """Geometric grid ~0.02 ms → ~120 s, two buckets per octave (sqrt-2
+    ratio): fine enough that p99 quantization error stays under ~41%."""
+    out: List[float] = []
+    v = 0.02
+    while v <= 130_000.0:
+        out.append(round(v, 4))
+        v *= 2 ** 0.5
+    return tuple(out)
+
+
+_BOUNDS_BY_KIND: Dict[str, Tuple[float, ...]] = {
+    "ms": _log_ms_bounds(),
+    # batch sizes: powers of two up to well past the largest qc bucket
+    "count": tuple(float(1 << i) for i in range(13)),
+    # ratios (pad waste): linear 0..1 in 5% steps
+    "ratio": tuple(i / 20 for i in range(1, 21)),
+}
+
+
+class Histogram:
+    """One fixed-boundary histogram. Thread-safe."""
+
+    __slots__ = ("name", "kind", "bounds", "_lock", "counts", "n", "total", "vmax")
+
+    def __init__(self, name: str, kind: str):
+        self.name = name
+        self.kind = kind
+        self.bounds = _BOUNDS_BY_KIND[kind]
+        self._lock = threading.Lock()
+        # one slot per bound plus overflow
+        self.counts = [0] * (len(self.bounds) + 1)  # guarded by: _lock
+        self.n = 0  # guarded by: _lock
+        self.total = 0.0  # guarded by: _lock
+        self.vmax = 0.0  # guarded by: _lock
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        if v < 0.0:
+            v = 0.0
+        i = bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.n += 1
+            self.total += v
+            if v > self.vmax:
+                self.vmax = v
+
+    def _percentile_locked(self, q: float) -> float:
+        """Upper bound of the bucket containing the q-quantile observation."""
+        rank = max(1, int(q * self.n + 0.999999))
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= rank:
+                return self.bounds[i] if i < len(self.bounds) else self.vmax
+        return self.vmax
+
+    def stats(self) -> dict:
+        with self._lock:
+            if self.n == 0:
+                return {"count": 0, "buckets": 0, "mean": 0.0, "p50": 0.0,
+                        "p90": 0.0, "p99": 0.0, "max": 0.0}
+            return {
+                "count": self.n,
+                "buckets": sum(1 for c in self.counts if c),
+                "mean": round(self.total / self.n, 4),
+                "p50": self._percentile_locked(0.50),
+                "p90": self._percentile_locked(0.90),
+                "p99": self._percentile_locked(0.99),
+                "max": round(self.vmax, 4),
+            }
+
+    def raw(self) -> dict:
+        """Mergeable form: bucket counts against the kind's fixed bounds."""
+        with self._lock:
+            return {"kind": self.kind, "counts": list(self.counts),
+                    "count": self.n, "total": self.total, "max": self.vmax}
+
+
+def merge_summaries(raws: List[dict]) -> dict:
+    """Merge ``Histogram.raw()`` dumps from several nodes into one summary.
+    Only valid within one kind — the fixed boundaries make this a plain
+    element-wise sum."""
+    if not raws:
+        return {"count": 0, "buckets": 0, "mean": 0.0, "p50": 0.0, "p90": 0.0,
+                "p99": 0.0, "max": 0.0}
+    kind = raws[0]["kind"]
+    merged = Histogram("merged", kind)
+    for r in raws:
+        if r["kind"] != kind:
+            raise ValueError(f"cannot merge histogram kinds {kind} and {r['kind']}")
+        for i, c in enumerate(r["counts"]):
+            merged.counts[i] += c
+        merged.n += r["count"]
+        merged.total += r["total"]
+        merged.vmax = max(merged.vmax, r["max"])
+    return merged.stats()
+
+
+# --- registry ---------------------------------------------------------------
+
+_REG_LOCK = threading.Lock()
+DECLARED: Dict[str, Tuple[str, str]] = {}  # name -> (kind, doc); import-time only
+_LIVE: Dict[str, Histogram] = {}  # guarded by: _REG_LOCK
+
+
+def declare_histogram(name: str, kind: str, doc: str) -> None:
+    if kind not in _BOUNDS_BY_KIND:
+        raise ValueError(f"unknown histogram kind {kind!r}")
+    DECLARED[name] = (kind, doc)
+
+
+class UndeclaredHistogramError(KeyError):
+    pass
+
+
+def _hist(name: str) -> Histogram:
+    h = _LIVE.get(name)
+    if h is not None:
+        return h
+    if name not in DECLARED:
+        raise UndeclaredHistogramError(
+            f"histogram {name!r} is not declared in common/metrics.py")
+    with _REG_LOCK:
+        h = _LIVE.get(name)
+        if h is None:
+            h = Histogram(name, DECLARED[name][0])
+            _LIVE[name] = h
+        return h
+
+
+def observe(name: str, value: float) -> None:
+    """Record one observation. ``name`` must be declared (tpulint TPU005
+    checks literal call sites against the declarations above)."""
+    _hist(name).record(value)
+
+
+def observe_if_declared(name: str, value: float) -> None:
+    """For dynamically composed names (``queue_wait.<pool>``): silently skip
+    names outside the registry so ad-hoc test pools don't blow up."""
+    if name in DECLARED:
+        _hist(name).record(value)
+
+
+def summary(name: str) -> Optional[dict]:
+    """Percentile summary for one declared histogram, or None if undeclared."""
+    if name not in DECLARED:
+        return None
+    return _hist(name).stats()
+
+
+def search_latency_stats() -> dict:
+    """The ``tpu_search_latency`` section of GET /_nodes/stats — the stats()
+    owner of every histogram declared below."""
+    return {name: _hist(name).stats() for name in DECLARED}
+
+
+def raw_dump(name: str) -> dict:
+    """Mergeable bucket dump for cross-node aggregation (tests, future
+    coordinator-side rollups)."""
+    return _hist(name).raw()
+
+
+def reset_for_tests() -> None:
+    with _REG_LOCK:
+        _LIVE.clear()
+
+
+# --- phase histograms (the flight recorder's standing distributions) --------
+# queue_wait.* names are composed dynamically in threadpool/pool.py via
+# observe_if_declared(f"queue_wait.{pool}"), one per named pool.
+declare_histogram("queue_wait.search", "ms", "queued->started wait, search pool")
+declare_histogram("queue_wait.write", "ms", "queued->started wait, write pool")
+declare_histogram("queue_wait.get", "ms", "queued->started wait, get pool")
+declare_histogram("queue_wait.management", "ms", "queued->started wait, management pool")
+declare_histogram("queue_wait.snapshot", "ms", "queued->started wait, snapshot pool")
+declare_histogram("coalesce_wait", "ms", "wait inside DispatchCoalescer (leader fill window + follower completion wait)")
+declare_histogram("device", "ms", "one device dispatch (coalesced batch or direct search_bool/search_many)")
+declare_histogram("demux", "ms", "per-request hit extraction from a batched device result")
+declare_histogram("fetch", "ms", "fetch phase (doc _source materialization)")
+declare_histogram("query", "ms", "shard query phase end-to-end (data node side)")
+declare_histogram("merge", "ms", "coordinator reduce of shard results")
+declare_histogram("rest_total", "ms", "whole _search request at the REST layer")
+declare_histogram("coalesce_batch_size", "count", "queries per coalesced device batch")
+declare_histogram("coalesce_pad_ratio", "ratio", "fraction of a padded device batch that is qc-quantization waste")

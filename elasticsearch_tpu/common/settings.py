@@ -369,6 +369,16 @@ declare_knob("ES_TPU_RECOVERY_RETRIES", "int", 3,
 declare_knob("ES_TPU_RECOVERY_BACKOFF_MS", "int", 50,
              "Base backoff between peer-recovery retries, ms (doubles per "
              "attempt)")
+# search flight recorder (PR 9)
+declare_knob("ES_TPU_TRACE_SAMPLE", "int", 0,
+             "Trace every Nth search even without profile=true or slowlog "
+             "thresholds (0 = off; sampled traces land in the trace ring)")
+declare_knob("ES_TPU_TRACE_RING", "int", 64,
+             "Capacity of the in-memory flight-recorder ring of completed "
+             "traces")
+declare_knob("ES_TPU_SLOWLOG_RING", "int", 128,
+             "Capacity of the in-memory search slowlog ring served at "
+             "GET /_tpu/slowlog")
 
 
 class ClusterSettings:

@@ -206,29 +206,41 @@ class IndexService:
         self._maybe_slow_log(request, resp)
         return resp
 
+    def effective_slowlog_thresholds(self) -> dict:
+        """Effective per-phase slowlog thresholds (ms) parsed from this
+        index's settings — {'query': {'warn': ms|None, ...}, 'fetch': ...}.
+        The seam every slowlog consumer reads (REST trace enablement, the
+        shard handlers, and this service's own check), so the parse
+        semantics ('-1' disables, bare numbers are ms) exist exactly once."""
+        from elasticsearch_tpu.common import tracing
+
+        return tracing.slowlog_thresholds(self.meta.settings)
+
     def _maybe_slow_log(self, request: dict, resp: dict) -> None:
         """Search slow log (ref: index/SearchSlowLog.java): queries over
-        index.search.slowlog.threshold.query.{warn,info} log with the
-        request source — the first stop when a query pattern goes bad."""
+        index.search.slowlog.threshold.query.{warn,info} append a
+        structured record (trace id + phase breakdown when the flight
+        recorder is on) to the bounded ring behind GET /_tpu/slowlog AND
+        log with the request source — the first stop when a query pattern
+        goes bad."""
         import json as _json
         import logging
 
-        from elasticsearch_tpu.tasks.task_manager import parse_timeout_ms
+        from elasticsearch_tpu.common import tracing
 
-        took = resp.get("took", 0)
-        for level in ("warn", "info"):
-            raw = self.meta.settings.raw(
-                f"index.search.slowlog.threshold.query.{level}")
-            if raw is None:
-                continue
-            thresh = parse_timeout_ms(raw)
-            if thresh is not None and took >= thresh:
-                logging.getLogger("index.search.slowlog").log(
-                    logging.WARNING if level == "warn" else logging.INFO,
-                    "[%s] took[%dms], source[%s]", self.name, took,
-                    _json.dumps({k: v for k, v in request.items()
-                                 if not k.startswith("_")})[:1000])
-                break
+        took = float(resp.get("took", 0))
+        th = self.effective_slowlog_thresholds().get("query") or {}
+        level = tracing.slowlog_check("query", took, th)
+        if level is None:
+            return
+        tracing.slowlog_record(
+            "query", level, self.name, took,
+            source=request.get("query"), tc=tracing.current())
+        logging.getLogger("index.search.slowlog").log(
+            logging.WARNING if level == "warn" else logging.INFO,
+            "[%s] took[%dms], source[%s]", self.name, int(took),
+            _json.dumps({k: v for k, v in request.items()
+                         if not k.startswith("_")})[:1000])
 
     def msearch(self, requests: List[dict],
                 search_type: str = "query_then_fetch") -> List[dict]:

@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
-from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.common import faults, tracing
 from elasticsearch_tpu.common.errors import DeviceFaultError
 from elasticsearch_tpu.common.faults import FaultRecord
 from elasticsearch_tpu.index.positions import phrase_freqs
@@ -1541,6 +1541,18 @@ class ShardedTurbo:
 
     # ---------------- fused dispatches ----------------
 
+    def _trace_chunk(self, QC: int, t0: float) -> None:
+        """Flight-recorder span per fused launch (spans only — the device
+        histogram is recorded once per dispatch at the coalescer/serving
+        layer; recording here too would double-count). The duration covers
+        the async launch, not the sweep itself — the caller's device span
+        includes the materializing fetch."""
+        tc = tracing.current()
+        if tc is not None:
+            tc.add_span("device.fused_chunk",
+                        (time.monotonic() - t0) * 1e3,
+                        partitions=len(self.turbos), qc=QC)
+
     def _dispatch_disj(self, chunk, QC: int, n_rows: int):
         wq = np.zeros((self.Sp, 2, QC, self.Hp + 1), np.int8)
         qs = np.ones((self.Sp, QC, 1), np.float32)
@@ -1551,12 +1563,14 @@ class ShardedTurbo:
         # the counter moves AFTER the launch so a faulted dispatch is not
         # counted — the circuit tests pin "zero device dispatches" while
         # open by watching it
+        t0 = time.monotonic()
         with faults.device_dispatch("fused_dispatch"):
             out = _fused_sweep_disj(
                 jnp.asarray(qs), self.cols_hi, self.cols_lo,
                 jnp.asarray(wq), self.live, mesh=self.mesh, QC=QC,
                 nsw=self.nsw, n_rows=n_rows)
         self.fused_dispatches += 1
+        self._trace_chunk(QC, t0)
         return out
 
     def _dispatch_bool(self, resolved, dev_sets, sel, QC: int,
@@ -1574,12 +1588,14 @@ class ShardedTurbo:
             wp[i, :, :hp] = p
             nreq[i] = nr
             qs[i] = q
+        t0 = time.monotonic()
         with faults.device_dispatch("fused_dispatch"):
             out = _fused_sweep_bool(
                 jnp.asarray(qs), jnp.asarray(nreq), self.cols_hi,
                 self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
                 mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
         self.fused_dispatches += 1
+        self._trace_chunk(QC, t0)
         return out
 
     # ---------------- search ----------------

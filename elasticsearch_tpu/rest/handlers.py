@@ -167,6 +167,9 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
     r("GET", "/_nodes/hot_threads", h.hot_threads)
+    # search flight recorder (PR 9)
+    r("GET", "/_tpu/slowlog", h.tpu_slowlog)
+    r("GET", "/_tpu/trace", h.tpu_traces)
     # lifecycle admin
     r("POST", "/{index}/_close", h.close_index)
     r("POST", "/{index}/_open", h.open_index)
@@ -967,7 +970,61 @@ class _Handlers:
                 fix(sub)
         return _ok(resp, status)
 
+    def _trace_enabled(self, req: RestRequest, body: dict) -> bool:
+        """Flight-recorder enablement for one search: profile requests,
+        every-Nth sampling (ES_TPU_TRACE_SAMPLE), or any target index with
+        a slowlog threshold configured (a slow query must carry phase
+        attribution when it lands in the slowlog)."""
+        from elasticsearch_tpu.common import tracing
+
+        if body.get("profile"):
+            return True
+        if tracing.should_sample():
+            return True
+        try:
+            names = self._resolve(req.param("index"))
+        except ElasticsearchTpuError:
+            return False
+        for n in names or ():
+            try:
+                th = self.node.indices.get(n).effective_slowlog_thresholds()
+            except Exception:  # noqa: BLE001 — enablement never fails a search
+                continue
+            if any(v is not None for per in th.values()
+                   for v in per.values()):
+                return True
+        return False
+
     def search(self, req: RestRequest) -> RestResponse:
+        """Search entry: wraps the phase runner in a per-request
+        TraceContext when the flight recorder is on (the `rest_total`
+        histogram records regardless). Traced profile responses gain a
+        `profile.tpu` section with the trace id and per-phase totals."""
+        from elasticsearch_tpu.common import metrics, tracing
+
+        body_view = req.body if isinstance(req.body, dict) else {}
+        tc = None
+        if tracing.current() is None and self._trace_enabled(req, body_view):
+            tc = tracing.TraceContext(
+                opaque_id=req.headers.get("x-opaque-id"),
+                node=self.node.node_name, kind="rest")
+        t0 = time.monotonic()
+        with tracing.activate(tc):
+            rr = self._search_inner(req)
+        total_ms = (time.monotonic() - t0) * 1e3
+        metrics.observe("rest_total", total_ms)
+        if tc is not None:
+            tc.add_span("rest_total", total_ms, path=req.path)
+            tracing.record_trace(tc)
+            if isinstance(rr.body, dict) and isinstance(
+                    rr.body.get("profile"), dict):
+                rr.body["profile"].setdefault("tpu", {
+                    "trace_id": tc.trace_id, "opaque_id": tc.opaque_id,
+                    "node": self.node.node_name,
+                    "phases": tc.phase_totals()})
+        return rr
+
+    def _search_inner(self, req: RestRequest) -> RestResponse:
         from elasticsearch_tpu.index.index_service import parse_keep_alive
 
         body = dict(req.body or {})
@@ -1904,10 +1961,28 @@ class _Handlers:
                 "tpu_health": _tpu_health_stats(),
                 "tpu_coordinator": _tpu_coordinator_stats(),
                 "tpu_durability": _tpu_durability_stats(),
+                "tpu_search_latency": _tpu_search_latency_stats(),
                 "tpu_settings": _tpu_settings_stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
         })
+
+    def tpu_slowlog(self, req: RestRequest) -> RestResponse:
+        """GET /_tpu/slowlog — the bounded in-memory search slowlog ring:
+        structured over-threshold records (phase, level, index, took_ms,
+        query source, trace id + per-phase breakdown when traced), newest
+        last, plus the cumulative per-level counters."""
+        from elasticsearch_tpu.common import tracing
+
+        return _ok({"slowlog": tracing.slowlog_entries(),
+                    **tracing.slowlog_stats()})
+
+    def tpu_traces(self, req: RestRequest) -> RestResponse:
+        """GET /_tpu/trace — the flight-recorder ring: recently completed
+        traced requests with their spans (bounded by ES_TPU_TRACE_RING)."""
+        from elasticsearch_tpu.common import tracing
+
+        return _ok({"traces": tracing.recent_traces()})
 
     # ---------- aliases ----------
 
@@ -2165,8 +2240,12 @@ class _Handlers:
 
     def cat_thread_pool(self, req: RestRequest) -> RestResponse:
         """GET /_cat/thread_pool[/{name}] — the reference's default
-        columns: node_name name active queue rejected."""
+        columns (node_name name active queue rejected) extended with the
+        flight recorder's queue-wait view: the smoothed queue-wait EWMA
+        and the queue-wait histogram p99 per pool (PR 9)."""
         import fnmatch as _fn
+
+        from elasticsearch_tpu.common import metrics
 
         want = req.param("name")
         pats = [p.strip() for p in want.split(",")] if want else None
@@ -2174,8 +2253,11 @@ class _Handlers:
         for name, st in sorted(self.node.thread_pool.stats().items()):
             if pats and not any(_fn.fnmatchcase(name, p) for p in pats):
                 continue
+            s = metrics.summary(f"queue_wait.{name}")
+            p99 = s["p99"] if s else 0.0
             rows.append(f"{self.node.node_name} {name} {st['active']} "
-                        f"{st['queue']} {st['rejected']}")
+                        f"{st['queue']} {st['rejected']} "
+                        f"{st['queue_ewma_ms']} {p99}")
         return RestResponse(body="\n".join(rows) + ("\n" if rows else ""),
                             content_type="text/plain")
 
@@ -2217,6 +2299,20 @@ def _tpu_health_stats() -> dict:
     out.update(serving_fault_stats())
     out["coalesce_batch_retries"] = \
         default_coalescer().stats()["coalesce_batch_retries"]
+    return out
+
+
+def _tpu_search_latency_stats() -> dict:
+    """Search flight-recorder section (PR 9): per-phase latency histogram
+    summaries (queue wait per pool, coalesce wait, device, demux, fetch,
+    query, merge, rest_total — p50/p90/p99/max over log-spaced buckets),
+    the coalescer's batch-size/pad-ratio distributions, and the slowlog
+    ring counters. Always on: histograms record whether or not any
+    request is traced."""
+    from elasticsearch_tpu.common import metrics, tracing
+
+    out = metrics.search_latency_stats()
+    out["slowlog"] = tracing.slowlog_stats()
     return out
 
 

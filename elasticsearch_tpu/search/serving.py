@@ -43,7 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.common import faults, metrics, tracing
 from elasticsearch_tpu.common.errors import (
     DeviceFaultError, SearchPhaseExecutionError,
 )
@@ -60,10 +60,13 @@ from elasticsearch_tpu.tasks.task_manager import (
 K1 = 1.2
 B = 0.75
 
-# request keys the fast path understands; anything else -> dense fallback
+# request keys the fast path understands; anything else -> dense fallback.
+# "profile" is allowed so profiled queries still exercise the engine that
+# would really serve them — the fast path answers with a DeviceDispatch
+# profile node naming that engine (fused_turbo / turbo / blockmax / host)
 _ALLOWED_KEYS = {"query", "size", "from", "_source", "stored_fields",
                  "track_total_hits", "version", "seq_no_primary_term",
-                 "timeout", "allow_partial_search_results"}
+                 "timeout", "allow_partial_search_results", "profile"}
 _MAX_K = 1000
 
 # serving-path fault/containment counters (GET /_nodes/stats tpu_health)
@@ -349,6 +352,67 @@ _TURBO_NODE_LOCK = threading.Lock()
 def turbo_node_stats() -> dict:
     with _TURBO_NODE_LOCK:
         return dict(_TURBO_NODE_STATS)
+
+
+def engine_desc(eng) -> Tuple[str, int]:
+    """(description, partition count) of the tier that would actually run
+    a dispatch right now — `fused_turbo` / `turbo` / `blockmax` / `host_tier`
+    (circuit open). Profile output and trace spans both use this so the
+    report names the engine that served the query, not the one configured."""
+    kind = getattr(eng, "kind", None)
+    parts = len(getattr(eng, "turbos", ()) or ()) or 1
+    if kind == "turbo":
+        health = getattr(eng, "health", None)
+        if health is not None and not health.allow_device():
+            return "host_tier", parts
+        if getattr(eng, "mesh", None) is not None and parts >= 2:
+            return "fused_turbo", parts
+        return "turbo", parts
+    return (kind or "host"), parts
+
+
+def device_profile_node(eng, dur_ms: float, parts: Optional[int] = None) -> dict:
+    """A QueryProfiler-shaped node for the device dispatch, merged into the
+    profile `searches.query` list next to the host query tree."""
+    desc, n_parts = engine_desc(eng)
+    return {"type": "DeviceDispatch",
+            "description": f"engine={desc} partitions={parts or n_parts}",
+            "time_in_nanos": int(dur_ms * 1e6)}
+
+
+def _synth_query_node(query_obj, time_ns: int) -> dict:
+    """QueryProfiler-shaped node for a parsed query object — same
+    (type, description) convention as QueryProfiler.push so profile output
+    keeps one schema whether the dense executor or the fast path served."""
+    node = {"type": type(query_obj).__name__,
+            "description": repr(query_obj)[:200],
+            "time_in_nanos": int(time_ns)}
+    kids = []
+    if isinstance(query_obj, q.BoolQuery):
+        kids = (list(query_obj.must) + list(query_obj.should)
+                + list(query_obj.filter) + list(query_obj.must_not))
+    elif isinstance(query_obj, q.ConstantScoreQuery) \
+            and query_obj.filter is not None:
+        kids = [query_obj.filter]
+    if kids:
+        node["children"] = [_synth_query_node(c, 0) for c in kids]
+    return node
+
+
+def fastpath_profile_nodes(request, eng, dur_ms: float,
+                           parts: Optional[int] = None) -> list:
+    """Profile `query` list for a fast-path-served request: the parsed query
+    tree with the dispatch time attributed to the root (the engine scores
+    the whole tree in one sweep — there is no per-node breakdown to report)
+    plus a DeviceDispatch node naming the tier that actually ran."""
+    nodes = []
+    try:
+        nodes.append(_synth_query_node(parse_query(request.get("query")),
+                                       int(dur_ms * 1e6)))
+    except Exception:   # profile must never fail the search
+        pass
+    nodes.append(device_profile_node(eng, dur_ms, parts=parts))
+    return nodes
 
 
 def _turbo_mesh(n_partitions: int):
@@ -1077,8 +1141,10 @@ class ServingContext:
             )
 
             try:
+                t_dev = time.monotonic()
                 scores, parts, ords = default_coalescer().dispatch(
                     eng, [plan.disj], k, check=check, fault_log=flog)
+                dev_ms = (time.monotonic() - t_dev) * 1e3
             except DispatchDeadlineError:
                 _count_serving("fastpath_timed_out")
                 return timed_out
@@ -1101,8 +1167,18 @@ class ServingContext:
             if spec is None:
                 return None
             try:
+                t_dev = time.monotonic()
                 scores, parts, ords = eng.search_bool(
                     [spec], k=k, check=check, fault_log=flog)
+                dev_ms = (time.monotonic() - t_dev) * 1e3
+                # search_bool bypasses the coalescer, so the device
+                # histogram is recorded here (the coalescer covers the
+                # disjunctive dispatches)
+                metrics.observe("device", dev_ms)
+                tc = tracing.current()
+                if tc is not None:
+                    tc.add_span("device", dev_ms,
+                                engine=engine_desc(eng)[0], batch=1)
             except DispatchDeadlineError:
                 _count_serving("fastpath_timed_out")
                 return timed_out
@@ -1113,6 +1189,7 @@ class ServingContext:
             return None
         if flog:
             _count_serving("shard_fault_recoveries", len(flog))
+        t_demux = time.monotonic()
         hits = []
         max_score = None
         for j in range(k):
@@ -1125,9 +1202,16 @@ class ServingContext:
                                  global_ord=part.base + o))
             max_score = s if max_score is None else max(max_score, s)
         total, relation = total_rel(plan, snap, request, len(hits))
+        demux_ms = (time.monotonic() - t_demux) * 1e3
+        metrics.observe("demux", demux_ms)
+        tc = tracing.current()
+        if tc is not None:
+            tc.add_span("demux", demux_ms)
         return QuerySearchResult(
             total=total, relation=relation, hits=hits, max_score=max_score,
-            timed_out=bool(deadline is not None and deadline.expired))
+            timed_out=bool(deadline is not None and deadline.expired),
+            profile=fastpath_profile_nodes(request, eng, dev_ms)
+            if request.get("profile") else None)
 
     # ---- disjunctive (device) ----
 
@@ -1186,8 +1270,10 @@ class ServingContext:
         from elasticsearch_tpu.threadpool.coalescer import default_coalescer
 
         try:
+            t_dev = time.monotonic()
             scores, parts, ords = default_coalescer().dispatch(
                 bm, queries, k, check=check, fault_log=flog)
+            dev_ms = (time.monotonic() - t_dev) * 1e3
         except DispatchDeadlineError:
             _count_serving("fastpath_timed_out")
             # expired requests report timed_out partials; the rest re-run
@@ -1204,7 +1290,8 @@ class ServingContext:
             health.record_success()
         if flog:
             _count_serving("shard_fault_recoveries", len(flog))
-        results = []
+        t_demux = time.monotonic()
+        extracted = []
         for qi, (plan, request) in enumerate(zip(plans, requests)):
             hits = []
             for j in range(k):
@@ -1213,12 +1300,23 @@ class ServingContext:
                 hits.append((int(parts[qi, j]), int(ords[qi, j]),
                              float(scores[qi, j])))
             total, relation = self._disj_total(plan, snap, request, len(hits))
+            extracted.append((hits, total, relation))
+        demux_ms = (time.monotonic() - t_demux) * 1e3
+        metrics.observe("demux", demux_ms)
+        tc = tracing.current()
+        if tc is not None:
+            tc.add_span("demux", demux_ms, batch=len(requests))
+        results = []
+        for qi, request in enumerate(requests):
+            hits, total, relation = extracted[qi]
             d = deadlines[qi]
             try:
                 results.append(self._respond(
                     request, snap, hits, total, relation, start,
                     timed_out=bool(d is not None and d.expired),
-                    faults=flog))
+                    faults=flog,
+                    profile_nodes=fastpath_profile_nodes(request, bm, dev_ms)
+                    if request.get("profile") else None))
             except SearchPhaseExecutionError as e:
                 results.append(e)
         return results
@@ -1287,8 +1385,16 @@ class ServingContext:
             check = self._combined_check(task, [deadline])
             flog: List[FaultRecord] = []
             try:
+                t_dev = time.monotonic()
                 scores, parts, ords = eng.search_bool(
                     [spec], k=k, check=check, fault_log=flog)
+                dev_ms = (time.monotonic() - t_dev) * 1e3
+                # search_bool bypasses the coalescer: record device here
+                metrics.observe("device", dev_ms)
+                tc = tracing.current()
+                if tc is not None:
+                    tc.add_span("device", dev_ms,
+                                engine=engine_desc(eng)[0], batch=1)
             except DispatchDeadlineError:
                 _count_serving("fastpath_timed_out")
                 return self._timed_out_response(request, snap, start)
@@ -1304,10 +1410,13 @@ class ServingContext:
             return self._respond(
                 request, snap, hits, total, relation, start,
                 timed_out=bool(deadline is not None and deadline.expired),
-                faults=flog)
+                faults=flog,
+                profile_nodes=fastpath_profile_nodes(request, eng, dev_ms)
+                if request.get("profile") else None)
         all_s, all_p, all_o = [], [], []
         total = 0
         timed_out = False
+        t_host = time.monotonic()
         for pi, part in enumerate(snap.partitions):
             if deadline is not None and deadline.expired:
                 # partial results over the partitions scored so far
@@ -1339,8 +1448,13 @@ class ServingContext:
             track_n = 1 << 62 if track is True else int(track)
             relation = "eq" if total <= track_n else "gte"
             total = min(total, track_n)
-        return self._respond(request, snap, hits, total, relation, start,
-                             timed_out=timed_out)
+        return self._respond(
+            request, snap, hits, total, relation, start,
+            timed_out=timed_out,
+            profile_nodes=fastpath_profile_nodes(
+                request, None, (time.monotonic() - t_host) * 1e3,
+                parts=len(snap.partitions))
+            if request.get("profile") else None)
 
     # ---- response assembly ----
 
@@ -1391,7 +1505,7 @@ class ServingContext:
         return out
 
     def _respond(self, request, snap, hits, total, relation, start,
-                 timed_out=False, faults=None):
+                 timed_out=False, faults=None, profile_nodes=None):
         from elasticsearch_tpu.search.fetch_phase import execute_fetch_phase
         from elasticsearch_tpu.search.query_phase import ShardHit
 
@@ -1409,6 +1523,7 @@ class ServingContext:
         window = hits[from_: from_ + size]
         max_score = hits[0][2] if hits else None
         out_hits = []
+        t_fetch = time.monotonic()
         for pi, ord_, score in window:
             part = snap.partitions[pi]
             sh = ShardHit(leaf_idx=part.leaf_idx, ord=ord_, score=score,
@@ -1419,6 +1534,11 @@ class ServingContext:
             if hit.get("_score") is None:
                 hit["_score"] = score
             out_hits.append(hit)
+        fetch_ms = (time.monotonic() - t_fetch) * 1e3
+        metrics.observe("fetch", fetch_ms)
+        tc = tracing.current()
+        if tc is not None:
+            tc.add_span("fetch", fetch_ms, hits=len(out_hits))
         took = int((time.monotonic() - start) * 1000)
         resp = {
             "took": took,
@@ -1430,6 +1550,15 @@ class ServingContext:
                 "hits": out_hits,
             },
         }
+        if profile_nodes is not None:
+            # same shape the coordinator/dense paths emit, so clients see
+            # one profile schema regardless of which tier served the query
+            resp["profile"] = {"shards": [{
+                "id": f"[{self.svc.name}][0]",
+                "searches": [{"query": profile_nodes,
+                              "rewrite_time": 0,
+                              "collector": []}],
+            }]}
         from elasticsearch_tpu.search.response import finalize_hits_envelope
 
         return finalize_hits_envelope(resp, request)

@@ -29,8 +29,10 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
+from elasticsearch_tpu.common import metrics, tracing
 from elasticsearch_tpu.common.settings import knob
 
 DEFAULT_WINDOW_US = 2000.0
@@ -63,6 +65,34 @@ def _engine_key(engine) -> int:
 def _env_window_us() -> float:
     # per-call registry read: tests toggle the window mid-process
     return knob("ES_TPU_COALESCE_US")
+
+
+def _record_device(engine, n_queries: int, dt_ms: float) -> None:
+    """Flight recorder: one device dispatch (this is the histogram's single
+    authoritative site for the disjunctive path — serving's search_bool
+    sites cover the conjunctive path that bypasses the coalescer)."""
+    metrics.observe("device", dt_ms)
+    tc = tracing.current()
+    if tc is not None:
+        tc.add_span("device", dt_ms, engine=getattr(engine, "kind", "?"),
+                    batch=n_queries)
+
+
+def _record_pad_waste(engine, n: int) -> None:
+    """Batch-shape histograms: how many query rows the qc quantization pads
+    on top of the real batch (the pad-waste the adaptive scheduler will
+    want to minimize)."""
+    metrics.observe("coalesce_batch_size", n)
+    sizes = getattr(engine, "qc_sizes", None)
+    if not sizes or n <= 0:
+        return
+    cap = sizes[-1]
+    full, rem = divmod(n, cap)
+    padded = full * cap
+    if rem:
+        padded += next((s for s in sizes if s >= rem), cap)
+    if padded > 0:
+        metrics.observe("coalesce_pad_ratio", (padded - n) / padded)
 
 
 def _accepts_fault_log(engine) -> bool:
@@ -155,8 +185,12 @@ class DispatchCoalescer:
         if window_s <= 0 or len(queries) > self.small_batch_max:
             with self._lock:
                 self._direct_dispatches += 1
-            return self._run(engine, queries, k, check=check,
-                             fault_log=fault_log)
+            t_dev = time.monotonic()
+            out = self._run(engine, queries, k, check=check,
+                            fault_log=fault_log)
+            _record_device(engine, len(queries),
+                           (time.monotonic() - t_dev) * 1e3)
+            return out
 
         with self._lock:
             # key under the lock so one engine gets exactly one serial
@@ -175,6 +209,7 @@ class DispatchCoalescer:
                 batch.fill.set()
 
         if leader:
+            t_wait = time.monotonic()
             batch.fill.wait(window_s)
             with self._lock:
                 # close the window: late arrivals start a fresh batch
@@ -186,9 +221,17 @@ class DispatchCoalescer:
                 self._coalesced_queries += n
                 if n > self._largest_batch:
                     self._largest_batch = n
+            wait_ms = (time.monotonic() - t_wait) * 1e3
+            metrics.observe("coalesce_wait", wait_ms)
+            _record_pad_waste(engine, n)
+            tc = tracing.current()
+            if tc is not None:
+                tc.add_span("coalesce_wait", wait_ms, role="leader", batch=n)
             try:
+                t_dev = time.monotonic()
                 batch.results = self._run(engine, batch.queries, batch.k,
                                           fault_log=batch.fault_log)
+                _record_device(engine, n, (time.monotonic() - t_dev) * 1e3)
             except Exception as e:
                 # poison-batch containment: a failed FUSED dispatch must
                 # not fail every waiter — retry each query solo once so
@@ -200,7 +243,13 @@ class DispatchCoalescer:
             finally:
                 batch.done.set()
         else:
+            t_wait = time.monotonic()
             batch.done.wait()
+            wait_ms = (time.monotonic() - t_wait) * 1e3
+            metrics.observe("coalesce_wait", wait_ms)
+            tc = tracing.current()
+            if tc is not None:
+                tc.add_span("coalesce_wait", wait_ms, role="follower")
         if check is not None:
             check()
         if batch.error is not None:

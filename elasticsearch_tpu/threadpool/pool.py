@@ -24,6 +24,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, Optional
 
+from elasticsearch_tpu.common import metrics, tracing
 from elasticsearch_tpu.common.errors import ElasticsearchTpuError
 from elasticsearch_tpu.common.settings import knob
 
@@ -46,7 +47,8 @@ _tls = threading.local()
 class _Task:
     """Submission handle: a tiny future (result or raised error)."""
 
-    __slots__ = ("fn", "args", "kwargs", "result", "error", "_done")
+    __slots__ = ("fn", "args", "kwargs", "result", "error", "_done",
+                 "submitted", "trace")
 
     def __init__(self, fn, args, kwargs):
         self.fn = fn
@@ -55,6 +57,10 @@ class _Task:
         self.result = None
         self.error: Optional[BaseException] = None
         self._done = threading.Event()
+        self.submitted = time.monotonic()
+        # the submitter's trace rides the task across the thread hop and is
+        # re-activated in the worker (flight recorder propagation)
+        self.trace = tracing.current()
 
     def run(self) -> None:
         try:
@@ -94,6 +100,7 @@ class FixedExecutor:
         self.completed = 0            # guarded by: _lock
         self.rejected = 0             # guarded by: _lock
         self.ewma_ms = 0.0            # guarded by: _lock
+        self.queue_ewma_ms = 0.0      # guarded by: _lock
 
     def submit(self, fn: Callable, *args, **kwargs) -> _Task:
         task = _Task(fn, args, kwargs)
@@ -134,8 +141,17 @@ class FixedExecutor:
                 self.active += 1
                 if self.active > self.largest:
                     self.largest = self.active
-            t0 = time.monotonic()
-            task.run()
+                t0 = time.monotonic()
+                qw_ms = (t0 - task.submitted) * 1e3
+                self.queue_ewma_ms = qw_ms if self.completed == 0 else \
+                    (1 - _EWMA_ALPHA) * self.queue_ewma_ms \
+                    + _EWMA_ALPHA * qw_ms
+            # composed name: ad-hoc test pools fall outside the registry
+            metrics.observe_if_declared(f"queue_wait.{self.name}", qw_ms)
+            if task.trace is not None:
+                task.trace.add_span(f"queue_wait.{self.name}", qw_ms)
+            with tracing.activate(task.trace):
+                task.run()
             dt_ms = (time.monotonic() - t0) * 1e3
             with self._lock:
                 self.active -= 1
@@ -155,6 +171,7 @@ class FixedExecutor:
                 "largest": self.largest,
                 "completed": self.completed,
                 "ewma_ms": round(self.ewma_ms, 3),
+                "queue_ewma_ms": round(self.queue_ewma_ms, 3),
             }
 
     def shutdown(self) -> None:

@@ -384,6 +384,59 @@ def test_tpu005_suppression():
     assert lint_sources([(_TPU005_PATH, src)]) == []
 
 
+# TPU005's histogram-registry pass (PR 9): literal observe() sites must
+# name a histogram declared in common/metrics.py, otherwise the metric
+# never surfaces in `tpu_search_latency` and raises at runtime.
+
+_METRICS_TWIN = (
+    "elasticsearch_tpu/common/metrics.py",
+    '''
+def declare_histogram(name, kind, doc):
+    pass
+
+declare_histogram("device", "ms", "one device dispatch")
+declare_histogram("queue_wait.search", "ms", "search pool wait")
+''',
+)
+
+
+def test_tpu005_undeclared_observe_detected():
+    bad = (_TPU005_PATH, '''
+from elasticsearch_tpu.common import metrics
+
+def record(ms):
+    metrics.observe("devcie", ms)
+''')
+    findings = lint_sources([_METRICS_TWIN, bad], select={"TPU005"})
+    assert rules_of(findings) == ["TPU005"]
+    assert "devcie" in findings[0].message
+
+
+def test_tpu005_declared_observe_clean():
+    ok = (_TPU005_PATH, '''
+from elasticsearch_tpu.common import metrics
+
+def record(ms, pool):
+    metrics.observe("device", ms)
+    # dynamically composed names go through the lenient entry point,
+    # which the rule deliberately ignores
+    metrics.observe_if_declared(f"queue_wait.{pool}", ms)
+''')
+    assert lint_sources([_METRICS_TWIN, ok], select={"TPU005"}) == []
+
+
+def test_tpu005_observe_pass_needs_registry_in_scope():
+    """Without metrics.py in the lint scope there is no declaration set, so
+    the rule must stay silent (fixture snippets would otherwise light up)."""
+    orphan = (_TPU005_PATH, '''
+from elasticsearch_tpu.common import metrics
+
+def record(ms):
+    metrics.observe("anything_at_all", ms)
+''')
+    assert lint_sources([orphan], select={"TPU005"}) == []
+
+
 # --------------------------------------------------------------------------
 # Baseline machinery
 # --------------------------------------------------------------------------

@@ -195,7 +195,12 @@ def test_http_server_sheds_load_with_429():
         with urllib.request.urlopen(base + "/_cat/thread_pool/search",
                                     timeout=15) as resp:
             line = resp.read().decode()
-        assert line.split() == [node.node_name, "search", "1", "1", "1"]
+        cols = line.split()
+        assert cols[:5] == [node.node_name, "search", "1", "1", "1"]
+        # PR 9 queue-wait columns: EWMA + histogram p99, both numeric
+        assert len(cols) == 7
+        float(cols[5])
+        float(cols[6])
     finally:
         release.set()
         t1.join(timeout=10)

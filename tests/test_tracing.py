@@ -1,0 +1,425 @@
+"""Search flight recorder (PR 9): log-bucketed latency histograms, trace
+propagation coordinator -> shard RPC -> back into `profile.tpu`, and the
+slowlog ring.
+
+The histogram units pin the mergeability contract (fixed per-kind bucket
+boundaries, element-wise sum across nodes); the cluster tests ride the same
+in-process harness as test_distributed/test_disruption and assert one trace
+id spans the coordinator and every data-node shard context — including
+across a PR 6 failover retry, where the failed and the successful rpc_query
+attempt land in the SAME trace. The differential test is the acceptance
+gate for "zero cost when disabled": sampled vs unsampled responses must be
+bit-identical.
+"""
+
+import json
+
+import pytest
+
+from elasticsearch_tpu.action.search_action import _COORD_COUNTERS
+from elasticsearch_tpu.cluster_node import form_local_cluster
+from elasticsearch_tpu.common import faults, metrics, tracing
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest import RestController, register_handlers
+
+MAPPINGS = {"properties": {"n": {"type": "integer"},
+                           "body": {"type": "text"}}}
+
+BODY = {"query": {"match": {"body": "common"}}, "size": 10,
+        "track_total_hits": True}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    """Rings and live histograms are module-global (shared by every node of
+    an in-process cluster) — isolate each test from its neighbors."""
+    metrics.reset_for_tests()
+    tracing.reset_for_tests()
+    yield
+    metrics.reset_for_tests()
+    tracing.reset_for_tests()
+
+
+def make_cluster(n_data=3):
+    names = ["m0"] + [f"d{i}" for i in range(n_data)]
+    return form_local_cluster(names, roles={"m0": ("master",)})
+
+
+def index_body(shards=2, replicas=1):
+    return {"settings": {"number_of_shards": shards,
+                         "number_of_replicas": replicas},
+            "mappings": MAPPINGS}
+
+
+def bulk_ops(start, count):
+    return [{"op": "index", "id": str(i),
+             "source": {"n": i, "body": f"word{i % 7} common text"}}
+            for i in range(start, start + count)]
+
+
+def ranked_first(coordinator, store, index="docs", sid=0):
+    copies = [r for r in store.current().shard_copies(index, sid)
+              if r.state == "STARTED"]
+    return coordinator.search_action._rank_copies(copies)[0]
+
+
+def normalized(resp):
+    out = dict(resp)
+    out.pop("took", None)
+    return out
+
+
+def has_key(obj, key):
+    if isinstance(obj, dict):
+        return key in obj or any(has_key(v, key) for v in obj.values())
+    if isinstance(obj, list):
+        return any(has_key(v, key) for v in obj)
+    return False
+
+
+# --------------------------------------------------------------------------
+# histogram units
+# --------------------------------------------------------------------------
+
+
+def test_histogram_bucket_boundaries():
+    h = metrics.Histogram("x", "ms")
+    # a value exactly on a bound lands in that bound's bucket (bisect_left);
+    # just above spills into the next one
+    h.record(h.bounds[10])
+    h.record(h.bounds[10] * 1.01)
+    counts = h.raw()["counts"]
+    assert counts[10] == 1 and counts[11] == 1
+    # negatives clamp to the first bucket, overflow goes to the final slot
+    h.record(-3.0)
+    h.record(1e9)
+    counts = h.raw()["counts"]
+    assert counts[0] == 1 and counts[-1] == 1
+    assert h.raw()["max"] == 1e9
+
+
+def test_histogram_percentiles():
+    h = metrics.Histogram("x", "ms")
+    for _ in range(90):
+        h.record(1.0)
+    for _ in range(10):
+        h.record(100.0)
+    st = h.stats()
+    assert st["count"] == 100
+    assert st["mean"] == pytest.approx(10.9)
+    # bucket upper bound of the quantile observation: p50/p90 in the ~1ms
+    # bucket, p99 in the ~100ms bucket (sqrt-2 grid => <=41% quantization)
+    assert 1.0 <= st["p50"] <= 1.5
+    assert 1.0 <= st["p90"] <= 1.5
+    assert 100.0 <= st["p99"] <= 150.0
+    assert st["max"] == 100.0
+    # overflow observations report the true max, not a bucket bound
+    h2 = metrics.Histogram("y", "ms")
+    h2.record(5e8)
+    assert h2.stats()["p99"] == 5e8
+
+
+def test_histogram_merge_across_nodes():
+    a = metrics.Histogram("a", "ms")
+    b = metrics.Histogram("b", "ms")
+    for v in range(10):
+        a.record(float(v))
+    for v in range(100, 110):
+        b.record(float(v))
+    merged = metrics.merge_summaries([a.raw(), b.raw()])
+    assert merged["count"] == 20
+    assert merged["max"] == 109.0
+    # merged median sits between the two nodes' medians
+    assert a.stats()["p50"] <= merged["p50"] <= b.stats()["p50"]
+    # merging is exactly element-wise: counts of the merged raw equal sums
+    summed = [x + y for x, y in zip(a.raw()["counts"], b.raw()["counts"])]
+    assert sum(summed) == 20
+    # one-node merge is the identity on the summary
+    assert metrics.merge_summaries([a.raw()]) == a.stats()
+    # kinds with different boundaries refuse to merge
+    c = metrics.Histogram("c", "count")
+    with pytest.raises(ValueError):
+        metrics.merge_summaries([a.raw(), c.raw()])
+    # empty merge yields the zero summary
+    assert metrics.merge_summaries([])["count"] == 0
+
+
+def test_registry_strict_and_lenient():
+    with pytest.raises(metrics.UndeclaredHistogramError):
+        metrics.observe("not_a_histogram", 1.0)
+    # dynamically composed names degrade to a no-op instead of raising
+    metrics.observe_if_declared("queue_wait.adhoc_test_pool", 1.0)
+    assert metrics.summary("not_a_histogram") is None
+    metrics.observe("device", 3.0)
+    assert metrics.summary("device")["count"] == 1
+    stats = metrics.search_latency_stats()
+    for name in ("queue_wait.search", "coalesce_wait", "device", "demux",
+                 "fetch", "query", "merge", "rest_total",
+                 "coalesce_batch_size", "coalesce_pad_ratio"):
+        assert name in stats and "p99" in stats[name]
+
+
+# --------------------------------------------------------------------------
+# trace context units
+# --------------------------------------------------------------------------
+
+
+def test_trace_context_spans_and_totals():
+    tc = tracing.TraceContext(node="n1", kind="rest")
+    tc.add_span("device", 2.0)
+    tc.add_span("device", 3.0, engine="turbo")
+    tc.add_span("fetch", 1.5)
+    tc.add_span("rest_total", 10.0)
+    totals = tc.phase_totals()
+    assert totals["device"] == 5.0 and totals["fetch"] == 1.5
+    # rest_total envelopes everything else; phase_totals excludes it
+    assert "rest_total" not in totals
+    with tc.span("merge", shards=2):
+        pass
+    assert any(s["name"] == "merge" and s["meta"] == {"shards": 2}
+               for s in tc.span_dicts())
+
+
+def test_trace_wire_roundtrip_and_activation():
+    tc = tracing.TraceContext(opaque_id="client-7", node="coord")
+    child = tracing.child_from_wire(tc.wire(), node="data-1", kind="shard_query")
+    assert child.trace_id == tc.trace_id
+    assert child.opaque_id == "client-7"
+    assert child.node == "data-1" and child.kind == "shard_query"
+    assert tracing.child_from_wire(None) is None
+    assert tracing.child_from_wire({}) is None
+    # activate(None) is a pass-through, real activation nests and restores
+    assert tracing.current() is None
+    with tracing.activate(None):
+        assert tracing.current() is None
+    with tracing.activate(tc):
+        assert tracing.current() is tc
+        with tracing.activate(child):
+            assert tracing.current() is child
+        assert tracing.current() is tc
+    assert tracing.current() is None
+
+
+def test_slowlog_threshold_parsing():
+    class _S:
+        def __init__(self, d):
+            self._d = d
+
+        def raw(self, key):
+            return self._d.get(key)
+
+    key = "index.search.slowlog.threshold.{}.{}"
+    th = tracing.slowlog_thresholds(_S({
+        key.format("query", "warn"): "500ms",
+        key.format("query", "info"): "-1",
+        key.format("fetch", "warn"): "1s",
+        key.format("fetch", "info"): 250,
+    }))
+    assert th["query"] == {"warn": 500.0, "info": None}
+    assert th["fetch"] == {"warn": 1000.0, "info": 250.0}
+    # unparseable values disable rather than blow up the search path
+    junk = tracing.slowlog_thresholds(
+        _S({key.format("query", "warn"): "soon-ish"}))
+    assert junk["query"]["warn"] is None
+    assert not tracing.slowlog_configured(_S({}))
+    assert tracing.slowlog_configured(
+        _S({key.format("query", "warn"): "0ms"}))
+    # warn outranks info when both match
+    per = {"warn": 100.0, "info": 10.0}
+    assert tracing.slowlog_check("query", 150.0, per) == "warn"
+    assert tracing.slowlog_check("query", 50.0, per) == "info"
+    assert tracing.slowlog_check("query", 5.0, per) is None
+
+
+# --------------------------------------------------------------------------
+# cross-node propagation (the tentpole)
+# --------------------------------------------------------------------------
+
+
+def _seeded_cluster():
+    nodes, store, channels = make_cluster()
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(2, 1))
+    a.bulk("docs", bulk_ops(0, 40))
+    a.refresh("docs")
+    return nodes, store, channels
+
+
+def test_trace_propagates_coordinator_to_shards():
+    nodes, store, channels = _seeded_cluster()
+    master = nodes[0]
+    r = master.search("docs", dict(BODY, profile=True))
+    assert r["_shards"]["failed"] == 0
+
+    tpu = r["profile"]["tpu"]
+    tid = tpu["trace_id"]
+    assert tid and tpu["node"] == "m0"
+    assert "rpc_query" in tpu["phases"] and "merge" in tpu["phases"]
+    # span sum stays consistent with took: no phase can exceed the request
+    assert max(tpu["phases"].values()) <= r["took"] + 250
+
+    same = [t for t in tracing.recent_traces() if t["trace_id"] == tid]
+    kinds = {t["kind"] for t in same}
+    assert "coordinator" in kinds and "shard_query" in kinds
+    # shard contexts ran on data nodes, never on the dedicated master
+    shard_nodes = {t["node"] for t in same if t["kind"] == "shard_query"}
+    assert shard_nodes and "m0" not in shard_nodes
+    # both shards surface a per-shard tpu breakdown in the profile
+    assert len(r["profile"]["shards"]) == 2
+    for entry in r["profile"]["shards"]:
+        assert entry["tpu"]["phases"]["query"] > 0
+        assert entry["tpu"]["node"] in shard_nodes
+    # internal span transport never leaks into the client response
+    assert not has_key(r, "_trace_spans")
+    # the shard query phase fed the node-wide histogram too
+    assert metrics.summary("query")["count"] >= 2
+    assert metrics.summary("merge")["count"] >= 1
+
+
+def test_failover_retry_shares_one_trace():
+    """PR 6 + PR 9: a faulted first attempt and its successful replica
+    retry are two rpc_query spans in the SAME trace, the failed one
+    carrying the error type and the node it died on."""
+    nodes, store, channels = _seeded_cluster()
+    master = nodes[0]
+    victim = ranked_first(master, store)
+    before = dict(_COORD_COUNTERS)
+    with faults.inject(f"rpc_query#{victim}:raisexinf"):
+        r = master.search("docs", dict(BODY, profile=True))
+    assert r["_shards"]["failed"] == 0
+    assert _COORD_COUNTERS["shard_retries"] - before["shard_retries"] >= 1
+
+    tid = r["profile"]["tpu"]["trace_id"]
+    coord = [t for t in tracing.recent_traces()
+             if t["trace_id"] == tid and t["kind"] == "coordinator"]
+    assert len(coord) == 1
+    rpc = [s for s in coord[0]["spans"] if s["name"] == "rpc_query"]
+    failed = [s for s in rpc if "error" in s["meta"]]
+    ok = [s for s in rpc if "error" not in s["meta"]]
+    assert failed and ok
+    assert all(s["meta"]["node"] == victim for s in failed)
+    # the shard that failed over still completed — on a different node
+    for f in failed:
+        retried = [s for s in ok if s["meta"]["shard"] == f["meta"]["shard"]]
+        assert retried and all(s["meta"]["node"] != victim for s in retried)
+        assert all(s["meta"]["attempt"] > f["meta"]["attempt"]
+                   for s in retried)
+
+
+def test_sampling_differential_bit_identity(monkeypatch):
+    """The disabled-by-default acceptance gate: turning the flight recorder
+    on (every-request sampling) must not change a single response byte."""
+    nodes, store, channels = _seeded_cluster()
+    master = nodes[0]
+    r_off = master.search("docs", BODY)
+    assert tracing.recent_traces() == []      # untraced by default
+
+    monkeypatch.setenv("ES_TPU_TRACE_SAMPLE", "1")
+    r_on = master.search("docs", BODY)
+    assert normalized(r_on) == normalized(r_off)
+    assert not has_key(r_on, "_trace_spans")
+    traces = tracing.recent_traces()
+    assert any(t["kind"] == "coordinator" for t in traces)
+    # shard children joined the sampled trace id
+    tid = next(t["trace_id"] for t in traces if t["kind"] == "coordinator")
+    assert any(t["kind"] == "shard_query" and t["trace_id"] == tid
+               for t in traces)
+
+
+# --------------------------------------------------------------------------
+# slowlog end-to-end through REST
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def env():
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+
+    def call(method, path, body=None, params=None, headers=None):
+        data = json.dumps(body).encode() if body is not None else None
+        resp = rc.dispatch(method, path, params or {}, data, headers=headers)
+        return resp.status, json.loads(resp.encode() or b"{}")
+
+    yield node, call
+    node.close()
+
+
+def test_slowlog_end_to_end(env):
+    node, call = env
+    st, _ = call("PUT", "/s", {"mappings": {"properties": {
+        "body": {"type": "text"}}}})
+    assert st == 200
+    # _disj_servable needs from+size <= the largest partition's doc count,
+    # or the fast path declines and no device/demux phases are recorded
+    for i in range(32):
+        call("PUT", f"/s/_doc/{i}", {"body": f"w{i % 4} common"})
+    call("POST", "/s/_refresh")
+
+    # no thresholds configured -> searches never reach the slowlog
+    st, r = call("POST", "/s/_search", {"query": {"match": {"body": "common"}}})
+    assert st == 200
+    st, slow = call("GET", "/_tpu/slowlog")
+    assert slow["slowlog"] == [] and slow["query_warn"] == 0
+
+    # thresholds arrive dynamically via _settings (the PR's bugfix: they
+    # live on index settings and IndexService parses them effectively)
+    st, _ = call("PUT", "/s/_settings", {"index": {"search": {"slowlog": {
+        "threshold": {"query": {"warn": "0ms"}}}}}})
+    assert st == 200
+    svc = node.indices.get("s")
+    th = svc.effective_slowlog_thresholds()
+    assert th["query"]["warn"] == 0.0 and th["query"]["info"] is None
+
+    st, r = call("POST", "/s/_search",
+                 {"query": {"match": {"body": "common"}}},
+                 headers={"X-Opaque-Id": "slowlog-e2e"})
+    assert st == 200
+
+    st, slow = call("GET", "/_tpu/slowlog")
+    assert slow["query_warn"] >= 1
+    entry = slow["slowlog"][-1]
+    assert entry["phase"] == "query" and entry["level"] == "warn"
+    assert entry["index"] == "s" and entry["took_ms"] >= 0
+    assert entry["source"] == {"match": {"body": "common"}}
+    # slowlog-configured index => the request was traced: the record has a
+    # trace id, the client correlation header, and a phase breakdown
+    assert entry["trace_id"] and entry["opaque_id"] == "slowlog-e2e"
+    assert "device" in entry["phases"] and "fetch" in entry["phases"]
+    # the same trace landed in the flight-recorder ring
+    st, tr = call("GET", "/_tpu/trace")
+    assert any(t["trace_id"] == entry["trace_id"] for t in tr["traces"])
+
+    # and node stats expose both the histograms and the slowlog counters
+    st, stats = call("GET", "/_nodes/stats")
+    lat = stats["nodes"][node.node_id]["tpu_search_latency"]
+    assert lat["rest_total"]["count"] >= 2
+    assert lat["device"]["count"] >= 1
+    assert lat["fetch"]["count"] >= 1
+    assert lat["slowlog"]["query_warn"] >= 1
+    assert lat["slowlog"]["ring_entries"] == len(slow["slowlog"])
+
+
+def test_profile_response_carries_rest_trace(env):
+    """Single-node profiled search: the REST layer owns the trace, so
+    profile.tpu names the rest context and phases include the fast-path
+    device/demux/fetch decomposition."""
+    node, call = env
+    call("PUT", "/s", {"mappings": {"properties": {
+        "body": {"type": "text"}}}})
+    for i in range(32):
+        call("PUT", f"/s/_doc/{i}", {"body": f"w{i % 4} common"})
+    call("POST", "/s/_refresh")
+
+    st, r = call("POST", "/s/_search",
+                 {"query": {"match": {"body": "common"}}, "profile": True,
+                  "size": 10},
+                 headers={"X-Opaque-Id": "prof-1"})
+    assert st == 200
+    tpu = r["profile"]["tpu"]
+    assert tpu["trace_id"] and tpu["opaque_id"] == "prof-1"
+    assert {"device", "demux", "fetch"} <= set(tpu["phases"])
+    # the profile query tree is still the classic shape next to the
+    # tpu section
+    assert r["profile"]["shards"][0]["searches"][0]["query"]

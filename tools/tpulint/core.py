@@ -169,6 +169,10 @@ class Project:
       like ``kernels.merge_topk(...)``.
     * ``knob_names``: ES_TPU_* knobs declared via ``declare_knob`` in
       common/settings.py, so TPU003 can flag undeclared/misspelled knobs.
+    * ``histogram_names``: flight-recorder histograms declared via
+      ``declare_histogram`` in common/metrics.py, so TPU005 can flag
+      ``observe("...")`` sites whose name the registry (and therefore
+      the ``tpu_search_latency`` stats surface) doesn't know.
     """
 
     def __init__(self, files: Sequence[FileContext]):
@@ -176,11 +180,14 @@ class Project:
         self.by_path = {f.path: f for f in self.files}
         self.jitted: Dict[str, Set[str]] = {}
         self.knob_names: Set[str] = set()
+        self.histogram_names: Set[str] = set()
         for f in self.files:
             mod = self._module_name(f.path)
             self.jitted[mod] = self._collect_jitted(f.tree)
             if f.path.endswith("common/settings.py"):
                 self.knob_names |= self._collect_knobs(f.tree)
+            if f.path.endswith("common/metrics.py"):
+                self.histogram_names |= self._collect_histograms(f.tree)
 
     @staticmethod
     def _module_name(path: str) -> str:
@@ -209,6 +216,18 @@ class Project:
         for node in ast.walk(tree):
             if isinstance(node, ast.Call) \
                     and dotted_tail(node.func) == "declare_knob" \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                names.add(node.args[0].value)
+        return names
+
+    @staticmethod
+    def _collect_histograms(tree: ast.Module) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and dotted_tail(node.func) == "declare_histogram" \
                     and node.args \
                     and isinstance(node.args[0], ast.Constant) \
                     and isinstance(node.args[0].value, str):

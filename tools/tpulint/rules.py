@@ -461,11 +461,20 @@ class CounterHygieneRule:
     """A class that exposes `stats()` must surface every counter it
     increments — `_nodes/stats` silently dropping a metric is how
     regressions hide (the counter looks alive in the code, but no
-    dashboard or differential test can see it move)."""
+    dashboard or differential test can see it move).
+
+    Same hygiene for the flight-recorder histograms: a literal
+    ``metrics.observe("name", …)`` site must name a histogram declared in
+    common/metrics.py — declared histograms all surface through
+    ``search_latency_stats()``, so an undeclared name is a metric that can
+    never reach `_nodes/stats` (and raises UndeclaredHistogramError the
+    first time the line runs). Dynamically composed names go through
+    ``observe_if_declared`` which this rule deliberately ignores."""
 
     name = "TPU005"
     summary = ("counters a stats()-bearing class increments (`self.x += …`) "
-               "must appear in its stats() surface")
+               "must appear in its stats() surface; literal observe(...) "
+               "sites must name a histogram declared in common/metrics.py")
 
     @staticmethod
     def _self_attr(expr: ast.AST) -> Optional[str]:
@@ -479,6 +488,26 @@ class CounterHygieneRule:
 
     def check(self, ctx: FileContext, project: Project) -> List[Finding]:
         out: List[Finding] = []
+        # histogram registry hygiene (skipped inside the registry itself,
+        # and entirely when the lint scope doesn't include metrics.py —
+        # fixture snippets must not see every observe() flagged)
+        if project.histogram_names \
+                and not ctx.path.endswith("common/metrics.py"):
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Call) \
+                        and dotted_tail(node.func) == "observe" \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and node.args[0].value not in project.histogram_names:
+                    f = ctx.finding(
+                        self.name, node,
+                        f"observe({node.args[0].value!r}) names a histogram "
+                        f"that is not declared in common/metrics.py — it "
+                        f"never surfaces in `tpu_search_latency` and raises "
+                        f"UndeclaredHistogramError at runtime")
+                    if f:
+                        out.append(f)
         for cls in [n for n in ast.walk(ctx.tree)
                     if isinstance(n, ast.ClassDef)]:
             stats_fns = [n for n in cls.body
