@@ -533,76 +533,136 @@ def main():
         f"{RESULT['vs_baseline']}x cpu, "
         f"agreement {match_agree}, p95(1) {c1['latency_ms_batch1_p95']}ms")
 
-    # ===== config1_concurrent: dispatch coalescer under open client load ==
-    # 32 client threads each firing batch-1 match queries at the SAME
-    # engine; the coalescer (threadpool/coalescer.py) merges the
-    # concurrent singles into padded device batches. Run twice — window
-    # from env (default 2000us) vs ES_TPU_COALESCE_US=0 semantics — and
-    # compare tail latency + top-10 agreement between the two runs.
+    # ===== config1_concurrent: dispatch scheduling under open client load ==
+    # Client-count sweep (1 bulk client in 4, the rest interactive): every
+    # client fires batch-1 match queries at the SAME engine through three
+    # dispatch paths — the adaptive continuous-batching scheduler
+    # (threadpool/scheduler.py), the legacy fixed-window coalescer, and no
+    # batching at all (window 0) — reporting per-tier p50/p95 and the
+    # device pad-ratio each path paid. Rows must stay bit-identical to the
+    # window-0 leg.
     if left() > 240:
+        from elasticsearch_tpu.common import metrics as _metrics
         from elasticsearch_tpu.threadpool.coalescer import DispatchCoalescer
+        from elasticsearch_tpu.threadpool.scheduler import (
+            TIER_BULK, TIER_INTERACTIVE, AdaptiveDispatchScheduler,
+        )
 
-        n_threads = 32
-        # size the run from the MEASURED batch-1 latency so the window=0
-        # leg (worst case: fully serialized singles) cannot starve the
-        # later configs — cap its estimated cost at min(60s, 15% budget)
-        p50_s = pct(lat1, 50) / 1e3
-        conc_budget_s = min(60.0, left() * 0.15)
-        per_thread = max(1, min(
-            8, int(conc_budget_s / max(n_threads * p50_s, 1e-6))))
-        log(f"config1_concurrent ({n_threads} threads x {per_thread})...")
-        thread_qs = [draw_batch(per_thread) for _ in range(n_threads)]
+        # size each leg from the MEASURED batch-1 latency so the window=0
+        # legs (worst case: fully serialized singles) cannot starve the
+        # later configs
+        p50_s = max(pct(lat1, 50) / 1e3, 1e-4)
+        conc_budget_s = min(150.0, left() * 0.3)
+        sweep_counts = (1, 8, 32, 128)
+        leg_budget_s = conc_budget_s / (3 * len(sweep_counts))
 
-        def run_concurrent(window_us):
-            co = DispatchCoalescer(window_us=window_us)
-            lats = [[] for _ in range(n_threads)]
-            ordrows = [[] for _ in range(n_threads)]
-            barrier = threading.Barrier(n_threads)
+        def pad_mean_since(before):
+            d = _metrics.raw_dump("coalesce_pad_ratio")
+            n = d["count"] - before["count"]
+            return round((d["total"] - before["total"]) / n, 4) \
+                if n > 0 else None
+
+        def run_leg(n_clients, thread_qs, tiers, dispatch_fn):
+            lat_lists = [[] for _ in range(n_clients)]
+            ordrows = [[] for _ in range(n_clients)]
+            barrier = threading.Barrier(n_clients)
+            pad0 = _metrics.raw_dump("coalesce_pad_ratio")
 
             def client(i):
                 barrier.wait()
                 for q in thread_qs[i]:
                     t1 = time.time()
-                    _, _, o = co.dispatch(eng, [q], K)
-                    lats[i].append(time.time() - t1)
+                    _, _, o = dispatch_fn(q, tiers[i])
+                    lat_lists[i].append(time.time() - t1)
                     ordrows[i].append(np.asarray(o[0]))
 
             ts = [threading.Thread(target=client, args=(i,), daemon=True)
-                  for i in range(n_threads)]
+                  for i in range(n_clients)]
             for t in ts:
                 t.start()
             for t in ts:
                 t.join()
-            flat = [x for xs in lats for x in xs]
+            by_tier = {TIER_INTERACTIVE: [], TIER_BULK: []}
+            for i, tier in enumerate(tiers):
+                by_tier[tier].extend(lat_lists[i])
             rows = [r for rs in ordrows for r in rs]
-            return flat, rows, co.stats()
+            return by_tier, rows, pad_mean_since(pad0)
 
-        solo_lat, solo_rows, _ = run_concurrent(0)
-        co_lat, co_rows, co_st = run_concurrent(None)
-        agree_conc = float(np.mean([np.array_equal(a, b) for a, b
-                                    in zip(co_rows, solo_rows)]))
+        def leg_summary(by_tier, pad):
+            flat = by_tier[TIER_INTERACTIVE] + by_tier[TIER_BULK]
+            out = {"p50_ms": round(pct(flat, 50), 1),
+                   "p95_ms": round(pct(flat, 95), 1),
+                   "pad_ratio": pad}
+            for tier, xs in by_tier.items():
+                if xs:
+                    out[tier] = {"p50_ms": round(pct(xs, 50), 1),
+                                 "p95_ms": round(pct(xs, 95), 1)}
+            return out
+
+        sweep = []
+        for n_clients in sweep_counts:
+            per_thread = max(1, min(
+                8, int(leg_budget_s / max(n_clients * p50_s, 1e-6))))
+            if left() < 3.5 * n_clients * per_thread * p50_s + 60:
+                log(f"config1_concurrent: skipping {n_clients} clients "
+                    f"(budget)")
+                continue
+            log(f"config1_concurrent ({n_clients} clients x "
+                f"{per_thread})...")
+            thread_qs = [draw_batch(per_thread) for _ in range(n_clients)]
+            tiers = [TIER_BULK if i % 4 == 3 else TIER_INTERACTIVE
+                     for i in range(n_clients)]
+
+            co0 = DispatchCoalescer(window_us=0)
+            solo_tier, solo_rows, solo_pad = run_leg(
+                n_clients, thread_qs, tiers,
+                lambda q, tier: co0.dispatch(eng, [q], K))
+            col = DispatchCoalescer(window_us=None)   # env window (2000us)
+            leg_tier, leg_rows, leg_pad = run_leg(
+                n_clients, thread_qs, tiers,
+                lambda q, tier: col.dispatch(eng, [q], K))
+            sched = AdaptiveDispatchScheduler()
+            ad_tier, ad_rows, ad_pad = run_leg(
+                n_clients, thread_qs, tiers,
+                lambda q, tier: sched.dispatch(eng, [q], K, tier=tier))
+
+            leg_st, ad_st = col.stats(), sched.stats()
+            agree_leg = float(np.mean([np.array_equal(a, b) for a, b
+                                       in zip(leg_rows, solo_rows)]))
+            agree_ad = float(np.mean([np.array_equal(a, b) for a, b
+                                      in zip(ad_rows, solo_rows)]))
+            entry = {
+                "clients": n_clients,
+                "queries_per_client": per_thread,
+                "bulk_clients": sum(1 for t in tiers if t == TIER_BULK),
+                "window0": leg_summary(solo_tier, solo_pad),
+                "legacy": {
+                    **leg_summary(leg_tier, leg_pad),
+                    "mean_batch": leg_st["mean_batch"],
+                    "largest_batch": leg_st["largest_batch"],
+                    "window_us": leg_st["window_us"],
+                    "top10_agreement": round(agree_leg, 4),
+                },
+                "adaptive": {
+                    **leg_summary(ad_tier, ad_pad),
+                    "mean_batch": ad_st["mean_batch"],
+                    "largest_batch": ad_st["largest_batch"],
+                    "bucket_counts": ad_st["bucket_counts"],
+                    "max_inflight": ad_st["max_inflight"],
+                    "top10_agreement": round(agree_ad, 4),
+                },
+            }
+            sweep.append(entry)
+            log(f"config1_concurrent {n_clients} clients: p95 "
+                f"{entry['adaptive']['p95_ms']}ms adaptive (mean batch "
+                f"{ad_st['mean_batch']}, pad {ad_pad}) vs "
+                f"{entry['legacy']['p95_ms']}ms legacy (pad {leg_pad}) vs "
+                f"{entry['window0']['p95_ms']}ms window=0, agreement "
+                f"{agree_ad}")
         detail["config1_concurrent"] = {
-            "threads": n_threads,
-            "queries_per_thread": per_thread,
-            "coalesced": {
-                "p50_ms": round(pct(co_lat, 50), 1),
-                "p95_ms": round(pct(co_lat, 95), 1),
-                "mean_batch": co_st["mean_batch"],
-                "largest_batch": co_st["largest_batch"],
-                "coalesced_dispatches": co_st["coalesced_dispatches"],
-                "direct_dispatches": co_st["direct_dispatches"],
-                "window_us": co_st["window_us"],
-            },
-            "window0": {
-                "p50_ms": round(pct(solo_lat, 50), 1),
-                "p95_ms": round(pct(solo_lat, 95), 1),
-            },
-            "top10_agreement": round(agree_conc, 4),
+            "mix": "3:1 interactive:bulk clients",
+            "sweep": sweep,
         }
-        log(f"config1_concurrent: p95 {pct(co_lat, 95):.0f}ms coalesced "
-            f"(mean batch {co_st['mean_batch']}) vs "
-            f"{pct(solo_lat, 95):.0f}ms window=0, "
-            f"agreement {agree_conc}")
 
     # ================= config 4: knn (cheap; before the host-heavy ones) ==
     if left() > 180:
@@ -1135,6 +1195,105 @@ def dryrun_trace() -> int:
     return 0 if ok else 1
 
 
+def dryrun_sched() -> int:
+    """Adaptive-scheduler smoke (PR 10): on the virtual CPU mesh, run
+    concurrent mixed-tier batch-1 searches through the continuous-batching
+    scheduler against a tiny 2-partition fused engine and assert the rows
+    are bit-identical to solo dispatch, that real merging happened, and
+    that both tiers were served. One JSON line on stdout; exit 0/1."""
+    os.environ.setdefault("ES_TPU_FORCE_TURBO", "1")
+    os.environ.setdefault("ES_TPU_COALESCE_US", "300000")
+    if os.environ.get("TEST_ON_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.index.segment import build_field_postings
+    from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+    from elasticsearch_tpu.parallel.turbo import TurboBM25
+    from elasticsearch_tpu.search.serving import TurboEngine, _turbo_mesh
+    from elasticsearch_tpu.threadpool.scheduler import (
+        TIER_BULK, TIER_INTERACTIVE, AdaptiveDispatchScheduler,
+    )
+
+    def part(n_docs, vocab, seed):
+        rng = np.random.default_rng(seed)
+        probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+        probs /= probs.sum()
+        lens = rng.integers(4, 24, size=n_docs).astype(np.int64)
+        tokens = rng.choice(vocab, size=int(lens.sum()),
+                            p=probs).astype(np.int64)
+        tok_docs = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+        fp = build_field_postings("body", lens, tok_docs, tokens,
+                                  [f"t{i}" for i in range(vocab)])
+        stacked = build_stacked_bm25([_Seg(n_docs, fp)], "body",
+                                     serve_only=True)
+        return TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=5)
+
+    log("dryrun_sched: building 2-partition fused engine...")
+    eng = TurboEngine([part(900, 40, 1), part(1300, 32, 2)],
+                      mesh=_turbo_mesh(2))
+    queries = [["t1", "t3"], ["t2", "t5"], ["t0", "t7"], ["t4", "t1"],
+               ["t6"], ["t8", "t2"], ["t3"], ["t9", "t0"]]
+    k = 10
+    solo = [eng.search_many([[q]], k=k)[0] for q in queries]
+
+    sched = AdaptiveDispatchScheduler(buckets=(len(queries),),
+                                      interactive_us=400000.0,
+                                      bulk_us=400000.0)
+    tiers = [TIER_BULK if i % 4 == 3 else TIER_INTERACTIVE
+             for i in range(len(queries))]
+    results = [None] * len(queries)
+    errors = []
+    barrier = threading.Barrier(len(queries))
+
+    def client(i):
+        try:
+            barrier.wait(timeout=30)
+            results[i] = sched.dispatch(eng, [queries[i]], k,
+                                        tier=tiers[i])
+        except BaseException as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    ts = [threading.Thread(target=client, args=(i,), daemon=True)
+          for i in range(len(queries))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    identical = not errors and all(
+        r is not None and all(np.array_equal(np.asarray(g), np.asarray(w))
+                              for g, w in zip(r, w3))
+        for r, w3 in zip(results, solo))
+    st = sched.stats()
+    merged = (st["sched_queries"] == len(queries)
+              and 1 <= st["sched_dispatches"] < len(queries))
+    tiers_served = (st["tiers"][TIER_INTERACTIVE]["dispatches"] == 6
+                    and st["tiers"][TIER_BULK]["dispatches"] == 2)
+    ok = identical and merged and tiers_served
+    print(json.dumps({
+        "metric": "dryrun_sched",
+        "ok": bool(ok),
+        "identical_to_solo": bool(identical),
+        "errors": errors,
+        "sched_dispatches": int(st["sched_dispatches"]),
+        "sched_queries": int(st["sched_queries"]),
+        "largest_batch": int(st["largest_batch"]),
+        "bucket_counts": st["bucket_counts"],
+        "tier_dispatches": {
+            t: st["tiers"][t]["dispatches"]
+            for t in (TIER_INTERACTIVE, TIER_BULK)},
+    }), flush=True)
+    log(f"dryrun_sched: identical={identical} "
+        f"flushes={st['sched_dispatches']} "
+        f"largest={st['largest_batch']}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -1151,4 +1310,7 @@ if __name__ == "__main__":
     if "dryrun_trace" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_trace":
         sys.exit(dryrun_trace())
+    if "dryrun_sched" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_sched":
+        sys.exit(dryrun_sched())
     main()

@@ -52,6 +52,7 @@ from elasticsearch_tpu.search.query_phase import (
     QuerySearchResult, ShardHit, _sort_key, execute_query_phase, parse_sort,
 )
 from elasticsearch_tpu.search.reader_context import ReaderContextRegistry
+from elasticsearch_tpu.threadpool import scheduler
 from elasticsearch_tpu.transport.channels import (
     NodeChannels, NodeUnavailableError, RpcTimeoutError,
 )
@@ -343,7 +344,7 @@ class SearchActionService:
                                      node=self.shards.node_name,
                                      kind="shard_query")
         t0 = time.monotonic()
-        with tracing.activate(tc):
+        with tracing.activate(tc), scheduler.activate_tier(p.get("_sla")):
             out = self._shard_query_inner(req)
         q_ms = (time.monotonic() - t0) * 1e3
         metrics.observe("query", q_ms)
@@ -635,7 +636,11 @@ class SearchActionService:
             attempted.append(node)
             tc = tracing.current()
             payload = {"index": target.index, "shard_id": target.sid,
-                       "body": self._shard_body(body, deadline)}
+                       "body": self._shard_body(body, deadline),
+                       # the coordinator's SLA tier rides to the data
+                       # node so its dispatch scheduler budgets the shard
+                       # query like the coordinator would
+                       "_sla": scheduler.current_tier()}
             if tc is not None:
                 # per-attempt propagation: every failover retry shares the
                 # SAME trace id, so a recovered request shows both the

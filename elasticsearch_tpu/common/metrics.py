@@ -207,3 +207,10 @@ declare_histogram("merge", "ms", "coordinator reduce of shard results")
 declare_histogram("rest_total", "ms", "whole _search request at the REST layer")
 declare_histogram("coalesce_batch_size", "count", "queries per coalesced device batch")
 declare_histogram("coalesce_pad_ratio", "ratio", "fraction of a padded device batch that is qc-quantization waste")
+# continuous-batching scheduler (PR 10); sched_tier_wait.* names are
+# composed dynamically in threadpool/scheduler.py via
+# observe_if_declared(f"sched_tier_wait.{tier}"), one per SLA tier.
+declare_histogram("sched_bucket_size", "count", "bucket (padded batch shape) chosen per adaptive-scheduler flush")
+declare_histogram("sched_queue_depth", "count", "lane queue depth at each adaptive-scheduler flush")
+declare_histogram("sched_tier_wait.interactive", "ms", "scheduler wait, interactive tier (enqueue -> batch results ready)")
+declare_histogram("sched_tier_wait.bulk", "ms", "scheduler wait, bulk tier (enqueue -> batch results ready)")

@@ -379,6 +379,23 @@ declare_knob("ES_TPU_TRACE_RING", "int", 64,
 declare_knob("ES_TPU_SLOWLOG_RING", "int", 128,
              "Capacity of the in-memory search slowlog ring served at "
              "GET /_tpu/slowlog")
+# continuous-batching dispatch scheduler (PR 10)
+declare_knob("ES_TPU_SCHED_MODE", "str", "adaptive",
+             "Serving dispatch path: 'adaptive' (continuous-batching "
+             "scheduler) or 'legacy' (fixed-window coalescer)")
+declare_knob("ES_TPU_SCHED_BUCKETS", "str", "1,4,16,64,256",
+             "Padded batch-size ladder for the adaptive scheduler "
+             "(comma-separated, each bucket is one compiled shape)")
+declare_knob("ES_TPU_SCHED_INTERACTIVE_US", "float", 1000.0,
+             "Max scheduler queue wait for interactive-tier queries, "
+             "microseconds")
+declare_knob("ES_TPU_SCHED_BULK_US", "float", 8000.0,
+             "Max scheduler queue wait for bulk-tier queries, "
+             "microseconds")
+declare_knob("ES_TPU_SCHED_INFLIGHT", "int", 2,
+             "In-flight device batches per scheduler lane (2 = "
+             "double-buffered: demux of batch N overlaps the sweep of "
+             "N+1)")
 
 
 class ClusterSettings:

@@ -314,6 +314,18 @@ class TurboBM25:
         self._terms[term] = info
         return info
 
+    def extend_qc_sizes(self, sizes) -> None:
+        """Widen the compiled dispatch-width ladder (the adaptive
+        scheduler's bucket hook): each new width is one more cached
+        kernel shape, with the same ROWS_PER_STEP rounding as the
+        constructor. Existing widths keep their jit cache entries —
+        extending is monotonic and idempotent."""
+        merged = set(self.qc_sizes)
+        merged.update(
+            max(ROWS_PER_STEP, -(-int(s) // ROWS_PER_STEP) * ROWS_PER_STEP)
+            for s in sizes)
+        self.qc_sizes = tuple(sorted(merged))
+
     # ---------------- column cache ----------------
 
     def _term_groups(self, info: _TermInfo, slot: int):
@@ -1516,6 +1528,14 @@ class ShardedTurbo:
         self._sharding = sh
         self._epochs = [-1] * S
         self.fused_dispatches = 0
+
+    def extend_qc_sizes(self, sizes) -> None:
+        """Bucket-ladder hook, fused flavor: keeps the fused chunker and
+        the per-partition engines (host rescore / fallback paths) on the
+        same widened width set."""
+        for t in self.turbos:
+            t.extend_qc_sizes(sizes)
+        self.qc_sizes = self.turbos[0].qc_sizes
 
     def _refresh_part(self, i: int) -> None:
         """Re-sync one partition's fused column slice if its cache was

@@ -1001,6 +1001,9 @@ class _Handlers:
         histogram records regardless). Traced profile responses gain a
         `profile.tpu` section with the trace id and per-phase totals."""
         from elasticsearch_tpu.common import metrics, tracing
+        from elasticsearch_tpu.threadpool import (
+            activate_tier, tier_for_request,
+        )
 
         body_view = req.body if isinstance(req.body, dict) else {}
         tc = None
@@ -1009,7 +1012,10 @@ class _Handlers:
                 opaque_id=req.headers.get("x-opaque-id"),
                 node=self.node.node_name, kind="rest")
         t0 = time.monotonic()
-        with tracing.activate(tc):
+        # SLA tier for the dispatch scheduler: classifier + optional
+        # `sla` request param, bound for the whole request like the trace
+        tier = tier_for_request(req.method, req.path, req.params)
+        with tracing.activate(tc), activate_tier(tier):
             rr = self._search_inner(req)
         total_ms = (time.monotonic() - t0) * 1e3
         metrics.observe("rest_total", total_ms)
@@ -1757,6 +1763,15 @@ class _Handlers:
         }
 
     def msearch(self, req: RestRequest) -> RestResponse:
+        from elasticsearch_tpu.threadpool import (
+            activate_tier, tier_for_request,
+        )
+
+        with activate_tier(tier_for_request(req.method, req.path,
+                                            req.params)):
+            return self._msearch_inner(req)
+
+    def _msearch_inner(self, req: RestRequest) -> RestResponse:
         lines = [ln for ln in req.raw_body.decode().split("\n") if ln.strip()]
         slots = []   # (index_names | None, body, error | None)
         i = 0
@@ -1957,6 +1972,7 @@ class _Handlers:
                 "indexing_pressure": self.node.indexing_pressure.stats(),
                 "thread_pool": self.node.thread_pool.stats(),
                 "tpu_coalescer": _default_coalescer_stats(),
+                "tpu_scheduler": _default_scheduler_stats(),
                 "tpu_turbo": _turbo_merge_stats(),
                 "tpu_health": _tpu_health_stats(),
                 "tpu_coordinator": _tpu_coordinator_stats(),
@@ -2275,6 +2291,12 @@ def _default_coalescer_stats() -> dict:
     from elasticsearch_tpu.threadpool.coalescer import default_coalescer
 
     return default_coalescer().stats()
+
+
+def _default_scheduler_stats() -> dict:
+    from elasticsearch_tpu.threadpool.scheduler import scheduler_stats
+
+    return scheduler_stats()
 
 
 def _turbo_merge_stats() -> dict:

@@ -51,6 +51,7 @@ from elasticsearch_tpu.common.faults import FaultRecord
 from elasticsearch_tpu.index.positions import phrase_freqs
 from elasticsearch_tpu.ops import bm25_idf
 from elasticsearch_tpu.common.settings import knob
+from elasticsearch_tpu.threadpool.coalescer import record_device
 from elasticsearch_tpu.search import queries as q
 from elasticsearch_tpu.search.queries import parse_query
 from elasticsearch_tpu.tasks.task_manager import (
@@ -484,6 +485,21 @@ class TurboEngine:
 
             self._sharded = ShardedTurbo(self.turbos, self.mesh)
         return self._sharded
+
+    @property
+    def qc_sizes(self):
+        """Compiled dispatch widths (pad-waste accounting + the adaptive
+        scheduler's bucket ladder read them through the engine facade).
+        Partitions share one width set by construction."""
+        return self.turbos[0].qc_sizes if self.turbos else ()
+
+    def extend_qc_sizes(self, sizes) -> None:
+        """Scheduler bucket-ladder hook: widen every partition's (and the
+        fused dispatcher's) compiled width set."""
+        for t in self.turbos:
+            t.extend_qc_sizes(sizes)
+        if self._sharded is not None:
+            self._sharded.extend_qc_sizes(sizes)
 
     def _host_tier_many(self, batches, k, check):
         """Whole-engine host-exact tier (circuit open / catastrophic
@@ -1134,15 +1150,18 @@ class ServingContext:
             if health is not None and not health.allow_device():
                 health.record_fallback(1)
                 return None             # circuit open: dense executor tier
-            # single-query dispatches ride the node's coalescer: concurrent
-            # shard queries on the same engine share ONE device dispatch
-            from elasticsearch_tpu.threadpool.coalescer import (
-                default_coalescer,
+            # single-query dispatches ride the node's adaptive scheduler:
+            # concurrent shard queries on the same engine continuous-batch
+            # into shared device dispatches (SLA tier from the request's
+            # thread-local class; ES_TPU_SCHED_MODE=legacy falls back to
+            # the fixed-window coalescer)
+            from elasticsearch_tpu.threadpool.scheduler import (
+                serving_dispatch,
             )
 
             try:
                 t_dev = time.monotonic()
-                scores, parts, ords = default_coalescer().dispatch(
+                scores, parts, ords = serving_dispatch(
                     eng, [plan.disj], k, check=check, fault_log=flog)
                 dev_ms = (time.monotonic() - t_dev) * 1e3
             except DispatchDeadlineError:
@@ -1171,14 +1190,12 @@ class ServingContext:
                 scores, parts, ords = eng.search_bool(
                     [spec], k=k, check=check, fault_log=flog)
                 dev_ms = (time.monotonic() - t_dev) * 1e3
-                # search_bool bypasses the coalescer, so the device
-                # histogram is recorded here (the coalescer covers the
-                # disjunctive dispatches)
-                metrics.observe("device", dev_ms)
-                tc = tracing.current()
-                if tc is not None:
-                    tc.add_span("device", dev_ms,
-                                engine=engine_desc(eng)[0], batch=1)
+                # search_bool bypasses the scheduler, so the conjunctive
+                # path's single authoritative device-histogram site is
+                # here (batch shape + pad waste ride along in the shared
+                # helper)
+                record_device(eng, 1, dev_ms,
+                              engine_name=engine_desc(eng)[0])
             except DispatchDeadlineError:
                 _count_serving("fastpath_timed_out")
                 return timed_out
@@ -1265,13 +1282,14 @@ class ServingContext:
             health.record_fallback(len(queries))
             return [None] * len(requests)
         flog: List[FaultRecord] = []
-        # small batches coalesce with concurrent dispatches on the same
-        # engine (threadpool/coalescer); large msearch batches go direct
-        from elasticsearch_tpu.threadpool.coalescer import default_coalescer
+        # small batches continuous-batch with concurrent dispatches on the
+        # same engine (threadpool/scheduler); large msearch batches go
+        # direct
+        from elasticsearch_tpu.threadpool.scheduler import serving_dispatch
 
         try:
             t_dev = time.monotonic()
-            scores, parts, ords = default_coalescer().dispatch(
+            scores, parts, ords = serving_dispatch(
                 bm, queries, k, check=check, fault_log=flog)
             dev_ms = (time.monotonic() - t_dev) * 1e3
         except DispatchDeadlineError:
@@ -1389,12 +1407,11 @@ class ServingContext:
                 scores, parts, ords = eng.search_bool(
                     [spec], k=k, check=check, fault_log=flog)
                 dev_ms = (time.monotonic() - t_dev) * 1e3
-                # search_bool bypasses the coalescer: record device here
-                metrics.observe("device", dev_ms)
-                tc = tracing.current()
-                if tc is not None:
-                    tc.add_span("device", dev_ms,
-                                engine=engine_desc(eng)[0], batch=1)
+                # search_bool bypasses the scheduler: this is the
+                # conjunctive path's device-histogram site (shape + pad
+                # waste included via the shared helper)
+                record_device(eng, 1, dev_ms,
+                              engine_name=engine_desc(eng)[0])
             except DispatchDeadlineError:
                 _count_serving("fastpath_timed_out")
                 return self._timed_out_response(request, snap, start)

@@ -67,21 +67,27 @@ def _env_window_us() -> float:
     return knob("ES_TPU_COALESCE_US")
 
 
-def _record_device(engine, n_queries: int, dt_ms: float) -> None:
-    """Flight recorder: one device dispatch (this is the histogram's single
-    authoritative site for the disjunctive path — serving's search_bool
-    sites cover the conjunctive path that bypasses the coalescer)."""
+def record_device(engine, n_queries: int, dt_ms: float,
+                  engine_name: Optional[str] = None) -> None:
+    """Flight recorder: one device dispatch. Every dispatch path funnels
+    through its single authoritative call site of this helper (coalescer
+    direct + leader, scheduler, serving's search_bool sites), so latency
+    AND batch-shape/pad-waste land together — including direct and fused
+    ShardedTurbo dispatches that the old leader-only pad accounting
+    missed."""
     metrics.observe("device", dt_ms)
+    record_pad_waste(engine, n_queries)
     tc = tracing.current()
     if tc is not None:
-        tc.add_span("device", dt_ms, engine=getattr(engine, "kind", "?"),
+        tc.add_span("device", dt_ms,
+                    engine=engine_name or getattr(engine, "kind", "?"),
                     batch=n_queries)
 
 
-def _record_pad_waste(engine, n: int) -> None:
+def record_pad_waste(engine, n: int) -> None:
     """Batch-shape histograms: how many query rows the qc quantization pads
-    on top of the real batch (the pad-waste the adaptive scheduler will
-    want to minimize)."""
+    on top of the real batch (the pad-waste the adaptive scheduler's
+    bucket ladder exists to minimize)."""
     metrics.observe("coalesce_batch_size", n)
     sizes = getattr(engine, "qc_sizes", None)
     if not sizes or n <= 0:
@@ -131,6 +137,39 @@ class _PendingBatch:
         self.error: Optional[BaseException] = None
         self.fault_log: List = []        # shard fault records (recovered)
         self.query_errors: Dict[int, BaseException] = {}  # slot -> error
+
+
+def retry_batch_solo(batch, original: BaseException) -> None:
+    """Poison-batch containment, shared by the coalescer and the adaptive
+    scheduler: re-run each of a failed merged batch's queries as its own
+    solo dispatch (once). Slots whose retry also fails carry their error
+    to exactly their waiter; if every retry fails the original batch
+    error goes to everyone. `batch` is any object with the _PendingBatch
+    result-surface (engine, k, queries, fault_log, results, error,
+    query_errors)."""
+    import numpy as np
+
+    rows: List = [None] * len(batch.queries)
+    errors: Dict[int, BaseException] = {}
+    for qi, query in enumerate(batch.queries):
+        try:
+            s, p, o = DispatchCoalescer._run(batch.engine, [query], batch.k,
+                                             fault_log=batch.fault_log)
+        except Exception as e:
+            errors[qi] = e
+            continue
+        rows[qi] = (np.asarray(s[0]), np.asarray(p[0]),
+                    np.asarray(o[0]))
+    if all(r is None for r in rows):
+        batch.error = original
+        return
+    template = next(r for r in rows if r is not None)
+    for qi, r in enumerate(rows):
+        if r is None:
+            rows[qi] = tuple(np.zeros_like(x) for x in template)
+    batch.results = tuple(np.stack([r[j] for r in rows])
+                          for j in range(3))
+    batch.query_errors = errors
 
 
 class DispatchCoalescer:
@@ -188,8 +227,8 @@ class DispatchCoalescer:
             t_dev = time.monotonic()
             out = self._run(engine, queries, k, check=check,
                             fault_log=fault_log)
-            _record_device(engine, len(queries),
-                           (time.monotonic() - t_dev) * 1e3)
+            record_device(engine, len(queries),
+                          (time.monotonic() - t_dev) * 1e3)
             return out
 
         with self._lock:
@@ -223,7 +262,6 @@ class DispatchCoalescer:
                     self._largest_batch = n
             wait_ms = (time.monotonic() - t_wait) * 1e3
             metrics.observe("coalesce_wait", wait_ms)
-            _record_pad_waste(engine, n)
             tc = tracing.current()
             if tc is not None:
                 tc.add_span("coalesce_wait", wait_ms, role="leader", batch=n)
@@ -231,7 +269,7 @@ class DispatchCoalescer:
                 t_dev = time.monotonic()
                 batch.results = self._run(engine, batch.queries, batch.k,
                                           fault_log=batch.fault_log)
-                _record_device(engine, n, (time.monotonic() - t_dev) * 1e3)
+                record_device(engine, n, (time.monotonic() - t_dev) * 1e3)
             except Exception as e:
                 # poison-batch containment: a failed FUSED dispatch must
                 # not fail every waiter — retry each query solo once so
@@ -266,35 +304,9 @@ class DispatchCoalescer:
 
     def _retry_solo(self, batch: _PendingBatch,
                     original: BaseException) -> None:
-        """Re-run each of a failed merged batch's queries as its own solo
-        dispatch (once). Slots whose retry also fails carry their error to
-        exactly their waiter; if every retry fails the original batch
-        error goes to everyone."""
-        import numpy as np
-
         with self._lock:
             self._batch_retries += 1
-        rows: List = [None] * len(batch.queries)
-        errors: Dict[int, BaseException] = {}
-        for qi, query in enumerate(batch.queries):
-            try:
-                s, p, o = self._run(batch.engine, [query], batch.k,
-                                    fault_log=batch.fault_log)
-            except Exception as e:
-                errors[qi] = e
-                continue
-            rows[qi] = (np.asarray(s[0]), np.asarray(p[0]),
-                        np.asarray(o[0]))
-        if all(r is None for r in rows):
-            batch.error = original
-            return
-        template = next(r for r in rows if r is not None)
-        for qi, r in enumerate(rows):
-            if r is None:
-                rows[qi] = tuple(np.zeros_like(x) for x in template)
-        batch.results = tuple(np.stack([r[j] for r in rows])
-                              for j in range(3))
-        batch.query_errors = errors
+        retry_batch_solo(batch, original)
 
     def stats(self) -> dict:
         with self._lock:

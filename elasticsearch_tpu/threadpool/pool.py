@@ -27,6 +27,7 @@ from typing import Callable, Dict, Optional
 from elasticsearch_tpu.common import metrics, tracing
 from elasticsearch_tpu.common.errors import ElasticsearchTpuError
 from elasticsearch_tpu.common.settings import knob
+from elasticsearch_tpu.threadpool import scheduler as _sched
 
 
 class EsRejectedExecutionError(ElasticsearchTpuError):
@@ -48,7 +49,7 @@ class _Task:
     """Submission handle: a tiny future (result or raised error)."""
 
     __slots__ = ("fn", "args", "kwargs", "result", "error", "_done",
-                 "submitted", "trace")
+                 "submitted", "trace", "tier")
 
     def __init__(self, fn, args, kwargs):
         self.fn = fn
@@ -58,9 +59,11 @@ class _Task:
         self.error: Optional[BaseException] = None
         self._done = threading.Event()
         self.submitted = time.monotonic()
-        # the submitter's trace rides the task across the thread hop and is
-        # re-activated in the worker (flight recorder propagation)
+        # the submitter's trace and SLA tier ride the task across the
+        # thread hop and are re-activated in the worker (flight recorder
+        # + scheduler-tier propagation)
         self.trace = tracing.current()
+        self.tier = _sched.current_tier()
 
     def run(self) -> None:
         try:
@@ -150,7 +153,8 @@ class FixedExecutor:
             metrics.observe_if_declared(f"queue_wait.{self.name}", qw_ms)
             if task.trace is not None:
                 task.trace.add_span(f"queue_wait.{self.name}", qw_ms)
-            with tracing.activate(task.trace):
+            with tracing.activate(task.trace), \
+                    _sched.activate_tier(task.tier):
                 task.run()
             dt_ms = (time.monotonic() - t0) * 1e3
             with self._lock:
@@ -205,6 +209,29 @@ def pool_for_request(method: str, path: str) -> str:
     if "_snapshot" in parts:
         return "snapshot"
     return "management"
+
+
+# endpoints that are batch/scan-shaped even though they ride the search
+# pool: their queries tolerate a wider scheduler pad, so they default to
+# the bulk SLA tier
+_BULK_SEARCH_ENDPOINTS = {"_msearch", "scroll", "_scroll", "_search_scroll",
+                          "_async_search", "_rank_eval", "_terms_enum"}
+
+
+def tier_for_request(method: str, path: str, params=None) -> str:
+    """SLA-tier classification for the adaptive dispatch scheduler: an
+    explicit `sla` request param wins; otherwise batch/scan endpoints and
+    everything outside the latency-sensitive search/get pools are bulk,
+    and interactive singles stay interactive."""
+    sla = (params or {}).get("sla")
+    if sla in (_sched.TIER_INTERACTIVE, _sched.TIER_BULK):
+        return sla
+    parts = set(p for p in path.split("?")[0].split("/") if p)
+    if parts & _BULK_SEARCH_ENDPOINTS:
+        return _sched.TIER_BULK
+    if pool_for_request(method, path) in ("search", "get"):
+        return _sched.TIER_INTERACTIVE
+    return _sched.TIER_BULK
 
 
 class ThreadPool:

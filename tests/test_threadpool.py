@@ -376,6 +376,10 @@ def test_serving_path_coalesces_concurrent_searches(monkeypatch):
     the process-default coalescer reports merged device dispatches."""
     svc = _build_index(monkeypatch, turbo=True, uuid="u_co4")
     try:
+        # pin the legacy fixed-window dispatch path: this test asserts the
+        # old coalescer's stats move; the adaptive scheduler has its own
+        # suite in test_scheduler.py
+        monkeypatch.setenv("ES_TPU_SCHED_MODE", "legacy")
         bodies = [{"query": {"match": {"body": " ".join(q)}}}
                   for q in QUERIES]
         monkeypatch.setenv("ES_TPU_COALESCE_US", "0")
