@@ -1294,6 +1294,90 @@ def dryrun_sched() -> int:
     return 0 if ok else 1
 
 
+def dryrun_tasks() -> int:
+    """Task-plane smoke (PR 11): on the 2-node in-process cluster, stall
+    one node's shard query, list the cross-node parent/child tree while
+    it is in flight, cancel the coordinator, and assert the remote child
+    dies within one dispatch boundary (ban received on the peer, search
+    fails with task_cancelled_exception) and that hot_threads fans out a
+    section per node. One JSON line on stdout; exit 0/1."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.cluster_node import form_local_cluster
+    from elasticsearch_tpu.tasks import TaskCancelledError
+
+    log("dryrun_tasks: forming 2-node cluster...")
+    nodes, store, channels = form_local_cluster(["n0", "n1"])
+    a, b = nodes
+    a.create_index("docs", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    a.bulk("docs", [{"op": "index", "id": str(i),
+                     "source": {"body": f"word{i % 5} common"}}
+                    for i in range(40)])
+    a.refresh("docs")
+
+    entered, release = threading.Event(), threading.Event()
+    orig = b.search_action._shard_query_inner
+
+    def slow(req):
+        entered.set()
+        release.wait(6.0)
+        return orig(req)
+
+    b.search_action._shard_query_inner = slow
+    out = {}
+
+    def run():
+        try:
+            out["r"] = a.search("docs", {
+                "query": {"match": {"body": "common"}}, "size": 5})
+        except BaseException as e:  # noqa: BLE001 — classified below
+            out["e"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    in_flight = entered.wait(5)
+    listing = a.task_plane.list(detailed=True)
+    tasks = {tid: d for sec in listing["nodes"].values()
+             for tid, d in sec["tasks"].items()}
+    parent_tid = next((tid for tid, d in tasks.items()
+                       if d.get("parent_task_id") is None), None)
+    children = [tid for tid, d in tasks.items()
+                if d.get("parent_task_id") == parent_tid]
+    remote_child = any(tid.startswith("n1:") for tid in children)
+    log(f"dryrun_tasks: parent={parent_tid} children={children}")
+    a.task_plane.cancel(parent_tid, reason="dryrun")
+    bans = b.tasks.stats()["bans_received"]
+    child_dead = all(x.is_cancelled for x in b.tasks.list())
+    release.set()
+    t.join(timeout=30)
+    b.search_action._shard_query_inner = orig
+    cancelled = isinstance(out.get("e"), TaskCancelledError)
+    report = a.task_plane.hot_threads()
+    fanout = "::: {n0}" in report and "::: {n1}" in report
+
+    ok = (in_flight and parent_tid is not None and remote_child
+          and bans >= 1 and child_dead and cancelled and fanout)
+    print(json.dumps({
+        "metric": "dryrun_tasks",
+        "ok": bool(ok),
+        "in_flight_listed": bool(in_flight),
+        "remote_child_linked": bool(remote_child),
+        "bans_received": int(bans),
+        "child_dead_at_boundary": bool(child_dead),
+        "search_cancelled": bool(cancelled),
+        "hot_threads_fanout": bool(fanout),
+    }), flush=True)
+    log(f"dryrun_tasks: remote_child={remote_child} bans={bans} "
+        f"cancelled={cancelled}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -1313,4 +1397,7 @@ if __name__ == "__main__":
     if "dryrun_sched" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_sched":
         sys.exit(dryrun_sched())
+    if "dryrun_tasks" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_tasks":
+        sys.exit(dryrun_tasks())
     main()

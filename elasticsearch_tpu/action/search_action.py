@@ -52,6 +52,7 @@ from elasticsearch_tpu.search.query_phase import (
     QuerySearchResult, ShardHit, _sort_key, execute_query_phase, parse_sort,
 )
 from elasticsearch_tpu.search.reader_context import ReaderContextRegistry
+from elasticsearch_tpu.tasks import task_manager as _taskmgr
 from elasticsearch_tpu.threadpool import scheduler
 from elasticsearch_tpu.transport.channels import (
     NodeChannels, NodeUnavailableError, RpcTimeoutError,
@@ -267,7 +268,7 @@ class SearchActionService:
 
     def __init__(self, transport: TransportService, channels: NodeChannels,
                  shard_service: DistributedShardService, breakers=None,
-                 thread_pool=None):
+                 thread_pool=None, tasks=None):
         from elasticsearch_tpu.common.breaker import (
             HierarchyCircuitBreakerService,
         )
@@ -275,6 +276,10 @@ class SearchActionService:
 
         self.channels = channels
         self.shards = shard_service
+        # node TaskManager (tasks/task_manager.py): shard query/fetch
+        # handlers register child tasks under the coordinator's
+        # `_parent_task` payload field when wired
+        self.tasks = tasks
         self.breakers = breakers or HierarchyCircuitBreakerService()
         self.contexts = ReaderContextRegistry()
         # shard query/fetch phases run on the node's SEARCH pool —
@@ -343,9 +348,20 @@ class SearchActionService:
         tc = tracing.child_from_wire(p.get("_trace"),
                                      node=self.shards.node_name,
                                      kind="shard_query")
+        child = self._register_child(ACTION_QUERY, p, tc)
         t0 = time.monotonic()
-        with tracing.activate(tc), scheduler.activate_tier(p.get("_sla")):
-            out = self._shard_query_inner(req)
+        try:
+            with tracing.activate(tc), \
+                    scheduler.activate_tier(p.get("_sla")), \
+                    _taskmgr.activate(child):
+                if child is not None:
+                    # ban raced this registration: die before any dispatch
+                    child.check()
+                    child.note_dispatch(phase="query")
+                out = self._shard_query_inner(req)
+        finally:
+            if child is not None:
+                self.tasks.unregister(child)
         q_ms = (time.monotonic() - t0) * 1e3
         metrics.observe("query", q_ms)
         if tc is not None:
@@ -405,20 +421,42 @@ class SearchActionService:
                 "suggest": suggest_out, "profile": qr.profile,
                 "timed_out": bool(getattr(qr, "timed_out", False))}
 
+    def _register_child(self, action: str, p: dict, tc):
+        """Shard-side child task linked by the coordinator's `_parent_task`
+        payload field (next to `_trace`/`_sla` — never in the body, which
+        would break extract_plan's allowed-keys fast path). Returns None
+        when the node has no TaskManager wired or no parent was sent."""
+        if self.tasks is None or not p.get("_parent_task"):
+            return None
+        where = f"[{p['index']}][{p['shard_id']}]" if "index" in p \
+            else f"[ctx {p.get('context_id')}]"
+        return self.tasks.register(
+            action, f"shard {where}", parent_task_id=p["_parent_task"],
+            trace_id=tc.trace_id if tc is not None else None,
+            sla=p.get("_sla"))
+
     def _on_shard_fetch(self, req) -> dict:
         p = req.payload
         tc = tracing.child_from_wire(p.get("_trace"),
                                      node=self.shards.node_name,
                                      kind="shard_fetch")
+        child = self._register_child(ACTION_FETCH, p, tc)
         ctx = self.contexts.get(p["context_id"])
         hits = [ShardHit(leaf_idx=h["leaf_idx"], ord=h["ord"],
                          score=h["score"], global_ord=h["global_ord"],
                          sort_values=h.get("sort_values"))
                 for h in p["hits"]]
         t0 = time.monotonic()
-        with tracing.activate(tc):
-            fetched = execute_fetch_phase(ctx.searcher, hits, p["body"],
-                                          ctx.index, mapper=ctx.mapper)
+        try:
+            with tracing.activate(tc), _taskmgr.activate(child):
+                if child is not None:
+                    child.check()
+                    child.note_dispatch(phase="fetch")
+                fetched = execute_fetch_phase(ctx.searcher, hits, p["body"],
+                                              ctx.index, mapper=ctx.mapper)
+        finally:
+            if child is not None:
+                self.tasks.unregister(child)
         f_ms = (time.monotonic() - t0) * 1e3
         metrics.observe("fetch", f_ms)
         out = {"hits": fetched}
@@ -641,6 +679,12 @@ class SearchActionService:
                        # node so its dispatch scheduler budgets the shard
                        # query like the coordinator would
                        "_sla": scheduler.current_tier()}
+            ct = _taskmgr.current_task()
+            if ct is not None:
+                # parent linkage rides the payload next to _trace/_sla
+                # (never the body): the data node registers its shard
+                # task as a cancellable child of this coordinator
+                payload["_parent_task"] = ct.task_id
             if tc is not None:
                 # per-attempt propagation: every failover retry shares the
                 # SAME trace id, so a recovered request shows both the
@@ -724,9 +768,19 @@ class SearchActionService:
                        state: Optional[ClusterState] = None) -> dict:
         """query_then_fetch across every target shard's best copy, with
         replica failover, deadline propagation, and partial-results
-        accounting (see module docstring). Wraps the phase runner in a
-        coordinator TraceContext when the flight recorder is on (an
-        already-active trace — the REST layer's — is reused as-is)."""
+        accounting (see module docstring). Registers a cancellable
+        coordinator task when no REST-layer task is already active, and
+        wraps the phase runner in a coordinator TraceContext when the
+        flight recorder is on (an already-active trace — the REST
+        layer's — is reused as-is)."""
+        if self.tasks is not None and _taskmgr.current_task() is None:
+            with self.tasks.task("indices:data/read/search",
+                                 f"indices[{index_expr}]"):
+                return self._execute_search_traced(index_expr, body, state)
+        return self._execute_search_traced(index_expr, body, state)
+
+    def _execute_search_traced(self, index_expr: str, body: dict,
+                               state: Optional[ClusterState] = None) -> dict:
         tc = tracing.current()
         if tc is not None:
             return self._execute_search_phases(index_expr, body, state)
@@ -734,6 +788,11 @@ class SearchActionService:
             return self._execute_search_phases(index_expr, body, state)
         tc = tracing.TraceContext(node=self.shards.node_name,
                                   kind="coordinator")
+        # the coordinator task registered before the trace existed —
+        # backfill so /_tasks shows the same id the flight recorder does
+        ct = _taskmgr.current_task()
+        if ct is not None and ct.trace_id is None:
+            ct.trace_id = tc.trace_id
         with tracing.activate(tc):
             resp = self._execute_search_phases(index_expr, body, state)
         tracing.record_trace(tc)
@@ -820,8 +879,15 @@ class SearchActionService:
         timed_out = False
         fetch_failed: set = set()
         fetched: Dict[Tuple[int, int], dict] = {}  # (shard_idx, pos) -> hit
+        ct = _taskmgr.current_task()
+        if ct is not None:
+            ct.phase = "query"
         try:
             for t in targets:
+                if ct is not None:
+                    # per-shard fan-out boundary: a cancel (or a ban from
+                    # a dead parent) stops the remaining shard lines here
+                    ct.check()
                 if deadline is not None and deadline.expired:
                     # budget exhausted mid-fan-out: remaining shards become
                     # timed-out partials, not an error (unless strict)
@@ -880,7 +946,11 @@ class SearchActionService:
             by_shard: Dict[int, List[dict]] = {}
             for si, h, r in window:
                 by_shard.setdefault(si, []).append(h)
+            if ct is not None:
+                ct.phase = "fetch"
             for si, hits in by_shard.items():
+                if ct is not None:
+                    ct.check()
                 r = shard_results[si]
                 node = r["_node"]
                 if deadline is not None and deadline.expired:
@@ -894,6 +964,9 @@ class SearchActionService:
                     continue
                 fetch_payload = {"context_id": r["context_id"],
                                  "hits": hits, "body": body}
+                ct_f = _taskmgr.current_task()
+                if ct_f is not None:
+                    fetch_payload["_parent_task"] = ct_f.task_id
                 tc_f = tracing.current()
                 if tc_f is not None:
                     fetch_payload["_trace"] = tc_f.wire()

@@ -79,15 +79,24 @@ class ClusterNode:
         # on ONE node-level ThreadPool, so saturating writes can never
         # occupy search workers (and vice versa)
         self.thread_pool = ThreadPool()
+        from elasticsearch_tpu.tasks import TaskManager
+        from elasticsearch_tpu.tasks.task_plane import TaskPlane
+
+        # node task registry + the cluster plane over it: _tasks fan-out,
+        # node-routed get/cancel, ban propagation, hot_threads fan-out
+        self.tasks = TaskManager(node_name)
+        self.task_plane = TaskPlane(
+            self.tasks, node_name, channels=channels,
+            state_fn=lambda: self.state, transport=self.transport)
         self.shard_service = DistributedShardService(
             node_name, self.transport, channels, self.master_client,
             data_path, indexing_pressure=self.indexing_pressure,
-            thread_pool=self.thread_pool)
+            thread_pool=self.thread_pool, tasks=self.tasks)
         self.applier = IndicesClusterStateService(
             node_name, self.shard_service, self.master_client)
         self.search_action = SearchActionService(
             self.transport, channels, self.shard_service,
-            thread_pool=self.thread_pool)
+            thread_pool=self.thread_pool, tasks=self.tasks)
         t = self.transport
         t.register_request_handler("indices:admin/create",
                                    self._on_create_index)
@@ -280,6 +289,11 @@ class ClusterNode:
             return self.allocation.disassociate_dead_nodes(state, dead)
 
         self.store.submit(updater)
+        # reap orphaned child tasks cluster-wide: a dead coordinator can
+        # never unblock its shard children, so every surviving node bans
+        # the dead node's id prefix (tasks/task_plane.py)
+        for name in names:
+            self.task_plane.broadcast_reap(name)
         return {"acknowledged": True}
 
     def _on_node_join(self, req) -> dict:
@@ -409,18 +423,31 @@ class ClusterNode:
 
         # coordinating-stage accounting against the node's ONE shared budget
         # (ref: TransportBulkAction holds coordinating bytes for the fan-out)
+        from elasticsearch_tpu.tasks import task_manager as _taskmgr
+
         with self.indexing_pressure.coordinating(_ops_bytes(ops)):
+            if _taskmgr.current_task() is None:
+                with self.tasks.task("indices:data/write/bulk",
+                                     f"bulk [{index}] ops[{len(ops)}]"):
+                    return self._bulk_dispatch(index, ops, by_shard,
+                                               retries, retry_delay)
             return self._bulk_dispatch(index, ops, by_shard, retries,
                                        retry_delay)
 
     def _bulk_dispatch(self, index: str, ops: List[dict],
                        by_shard: Dict[int, List[Tuple[int, dict]]],
                        retries: int, retry_delay: float) -> dict:
+        from elasticsearch_tpu.tasks import task_manager as _taskmgr
+
         results: List[Optional[dict]] = [None] * len(ops)
         errors = False
         timeout_ms = knob("ES_TPU_BULK_TIMEOUT_MS")
         deadline = time.monotonic() + timeout_ms / 1000.0 if timeout_ms else None
+        ct = _taskmgr.current_task()
         for sid, items in by_shard.items():
+            if ct is not None:
+                # per-shard fan-out boundary (same contract as search)
+                ct.check()
             payload_ops = [op for _, op in items]
             resp = None
             last_err: Optional[Exception] = None
@@ -450,13 +477,19 @@ class ClusterNode:
                         f"[{primary.node_id}]")
                     time.sleep(retry_delay)
                     continue
+                bulk_payload = {
+                    "index": index, "shard_id": sid,
+                    "primary_term": state.indices[index].primary_term(sid),
+                    "ops": payload_ops,
+                    "ops_bytes": _ops_bytes(payload_ops)}
+                if ct is not None:
+                    # parent linkage rides the payload top level (next to
+                    # ops), so the primary registers a cancellable child
+                    bulk_payload["_parent_task"] = ct.task_id
                 try:
                     resp = self.channels.request(
                         primary.node_id, "indices:data/write/bulk[s]",
-                        {"index": index, "shard_id": sid,
-                         "primary_term": state.indices[index].primary_term(sid),
-                         "ops": payload_ops,
-                         "ops_bytes": _ops_bytes(payload_ops)})
+                        bulk_payload)
                     self.search_action._record_transport_outcome(
                         primary.node_id)
                     break

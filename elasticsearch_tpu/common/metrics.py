@@ -214,3 +214,13 @@ declare_histogram("sched_bucket_size", "count", "bucket (padded batch shape) cho
 declare_histogram("sched_queue_depth", "count", "lane queue depth at each adaptive-scheduler flush")
 declare_histogram("sched_tier_wait.interactive", "ms", "scheduler wait, interactive tier (enqueue -> batch results ready)")
 declare_histogram("sched_tier_wait.bulk", "ms", "scheduler wait, bulk tier (enqueue -> batch results ready)")
+# cluster task plane (PR 11); task_duration.* names are composed
+# dynamically in tasks/task_manager.py via
+# observe_if_declared(f"task_duration.{action_family(...)}"), one per
+# action family.
+declare_histogram("task_duration.search", "ms", "task lifetime, search-family actions (register -> unregister)")
+declare_histogram("task_duration.scroll", "ms", "task lifetime, scroll-family actions")
+declare_histogram("task_duration.msearch", "ms", "task lifetime, msearch coordinator actions")
+declare_histogram("task_duration.bulk", "ms", "task lifetime, bulk-family actions")
+declare_histogram("task_duration.async_search", "ms", "task lifetime, async-search actions")
+declare_histogram("task_duration.reindex", "ms", "task lifetime, reindex actions")

@@ -396,6 +396,18 @@ declare_knob("ES_TPU_SCHED_INFLIGHT", "int", 2,
              "In-flight device batches per scheduler lane (2 = "
              "double-buffered: demux of batch N overlaps the sweep of "
              "N+1)")
+# cluster task plane (PR 11)
+declare_knob("ES_TPU_TASK_BAN_TTL_S", "float", 300.0,
+             "Lifetime of a cancellation ban entry: racing child "
+             "registrations for a banned parent are cancelled on arrival "
+             "until the ban expires")
+declare_knob("ES_TPU_TASK_FANOUT_TIMEOUT_MS", "int", 2000,
+             "Per-peer budget for _tasks / hot_threads / ban fan-out RPCs "
+             "(a dead peer degrades to node_failures instead of hanging "
+             "the coordinator)")
+declare_knob("ES_TPU_HOT_THREADS_INTERVAL_MS", "int", 15,
+             "Sleep between the two stack samples of a hot_threads "
+             "capture (threads idle across both samples are filtered)")
 
 
 class ClusterSettings:

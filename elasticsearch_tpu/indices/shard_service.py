@@ -104,12 +104,15 @@ class DistributedShardService:
                  channels: NodeChannels,
                  master_client: Callable[[str, dict], dict],
                  data_path: Optional[str] = None,
-                 indexing_pressure=None, thread_pool=None):
+                 indexing_pressure=None, thread_pool=None, tasks=None):
         self.node_name = node_name
         self.transport = transport
         self.channels = channels
         self.master_client = master_client
         self.data_path = data_path
+        # node TaskManager: primary-bulk handlers register child tasks
+        # under the coordinator's `_parent_task` payload field when wired
+        self.tasks = tasks
         self.shards: Dict[Tuple[str, int], ShardInstance] = {}
         self.state: ClusterState = ClusterState()
         self._registry_lock = threading.Lock()
@@ -208,6 +211,31 @@ class DistributedShardService:
     # ---------------- write path (primary side) ----------------
 
     def _on_primary_bulk(self, req) -> dict:
+        from elasticsearch_tpu.tasks import task_manager as _taskmgr
+
+        p = req.payload
+        child = None
+        if self.tasks is not None and p.get("_parent_task"):
+            # child write task linked by the coordinator's `_parent_task`
+            # payload field (next to the op list, never inside an op)
+            child = self.tasks.register(
+                "indices:data/write/bulk[s]",
+                f"shard [{p['index']}][{p['shard_id']}] "
+                f"ops[{len(p['ops'])}]",
+                parent_task_id=p["_parent_task"])
+        try:
+            with _taskmgr.activate(child):
+                if child is not None:
+                    # ban raced this registration: reject before any op
+                    # is applied (the coordinator fails these items)
+                    child.check()
+                    child.note_dispatch(phase="bulk")
+                return self._primary_bulk_inner(req)
+        finally:
+            if child is not None:
+                self.tasks.unregister(child)
+
+    def _primary_bulk_inner(self, req) -> dict:
         p = req.payload
         inst = self.get_shard(p["index"], p["shard_id"])
         if not inst.primary:

@@ -81,6 +81,13 @@ class Node:
         from elasticsearch_tpu.tasks import TaskManager
 
         self.tasks = TaskManager(self.node_id)
+        from elasticsearch_tpu.tasks.task_plane import TaskPlane
+
+        # standalone node: the task plane degrades to the local registry
+        # (no channels / cluster state), same REST response shapes
+        self.task_plane = TaskPlane(
+            self.tasks, self.node_name,
+            hot_label=f"{{{self.node_name}}}{{{self.node_id}}}")
         self._async_searches: Dict[str, dict] = {}
         from elasticsearch_tpu.ingest import IngestService
 

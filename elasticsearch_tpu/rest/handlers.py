@@ -217,6 +217,7 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/_cat/templates", h.cat_templates)
     r("GET", "/_cat/thread_pool", h.cat_thread_pool)
     r("GET", "/_cat/thread_pool/{name}", h.cat_thread_pool)
+    r("GET", "/_cat/tasks", h.cat_tasks)
 
 
 def _render_search_template(source, params: dict):
@@ -874,7 +875,14 @@ class _Handlers:
         The whole request's bytes are reserved on the node's
         IndexingPressure for the bulk's lifetime — a flood bounces with
         429 instead of buffering unbounded (ref: IndexingPressure.java)."""
+        from elasticsearch_tpu.tasks import task_manager as _taskmgr
+
         with self.node.indexing_pressure.coordinating(len(req.raw_body)):
+            if _taskmgr.current_task() is None:
+                with self.node.tasks.task(
+                        "indices:data/write/bulk",
+                        f"bulk bytes[{len(req.raw_body)}]"):
+                    return self._bulk_inner(req)
             return self._bulk_inner(req)
 
     def _bulk_inner(self, req: RestRequest) -> RestResponse:
@@ -1115,19 +1123,11 @@ class _Handlers:
         return _ok({"succeeded": ok, "num_freed": int(ok)})
 
     def hot_threads(self, req: RestRequest) -> RestResponse:
-        """ref: RestNodesHotThreadsAction — live thread stack dump, the
-        first tracing stop for a wedged node."""
-        import sys
-        import threading as _t
-        import traceback
-
-        names = {t.ident: t.name for t in _t.enumerate()}
-        lines = [f"::: {{{self.node.node_name}}}{{{self.node.node_id}}}"]
-        for tid, frame in sys._current_frames().items():
-            lines.append(f"\n   thread [{names.get(tid, tid)}] id [{tid}]:")
-            lines.extend("     " + ln.rstrip() for ln in
-                         traceback.format_stack(frame)[-12:])
-        return RestResponse(status=200, body="\n".join(lines) + "\n",
+        """ref: RestNodesHotThreadsAction — two-sample stack diff per node,
+        fanned out across the cluster by the task plane; idle pool workers
+        whose stacks didn't move between samples are elided."""
+        return RestResponse(status=200,
+                            body=self.node.task_plane.hot_threads(),
                             content_type="text/plain")
 
     # ---------- termvectors / templates(search) ----------
@@ -1687,40 +1687,28 @@ class _Handlers:
     # ---------- tasks (ref: RestListTasksAction, RestCancelTasksAction) ----------
 
     def list_tasks(self, req: RestRequest) -> RestResponse:
-        tasks = self.node.tasks.list(req.param("actions"))
-        return _ok({"nodes": {self.node.tasks.node_id: {
-            "tasks": {f"{t.node}:{t.id}": t.to_dict() for t in tasks}}}})
+        """Cluster-wide listing via the task plane: fans out over every
+        cluster node, degrades to partial results + `node_failures` when
+        a peer is dead (ref: TransportListTasksAction)."""
+        return _ok(self.node.task_plane.list(
+            actions=req.param("actions"),
+            nodes=req.param("nodes"),
+            parent_task_id=req.param("parent_task_id"),
+            detailed=req.param_bool("detailed"),
+            group_by=req.param("group_by", "nodes")))
 
     def get_task(self, req: RestRequest) -> RestResponse:
-        tid = req.param("task_id", "")
-        try:
-            task_num = int(tid.split(":")[-1])
-        except ValueError:
-            raise IllegalArgumentError(f"malformed task id [{tid}]")
-        t = self.node.tasks.get(task_num)
-        if t is None:
-            from elasticsearch_tpu.common.errors import ElasticsearchTpuError
-
-            e = ElasticsearchTpuError(f"task [{tid}] isn't running")
-            e.status = 404
-            raise e
-        return _ok({"completed": False, "task": t.to_dict()})
+        # routed by the `{node}:{id}` prefix — a remote owner answers over
+        # the transport; an unknown/dead owner 404s (malformed ids 400)
+        return _ok(self.node.task_plane.get(req.param("task_id", "")))
 
     def cancel_task(self, req: RestRequest) -> RestResponse:
-        tid = req.param("task_id", "")
-        try:
-            task_num = int(tid.split(":")[-1])
-        except ValueError:
-            raise IllegalArgumentError(f"malformed task id [{tid}]")
-        t = self.node.tasks.cancel(task_num)
-        if t is None:
-            from elasticsearch_tpu.common.errors import ElasticsearchTpuError
+        from elasticsearch_tpu.tasks.task_manager import parse_timeout_ms
 
-            e = ElasticsearchTpuError(f"task [{tid}] isn't running")
-            e.status = 404
-            raise e
-        return _ok({"nodes": {self.node.tasks.node_id: {
-            "tasks": {f"{t.node}:{t.id}": t.to_dict()}}}})
+        return _ok(self.node.task_plane.cancel(
+            req.param("task_id", ""),
+            wait_for_completion=req.param_bool("wait_for_completion"),
+            timeout_ms=parse_timeout_ms(req.param("timeout"))))
 
     def cancel_tasks(self, req: RestRequest) -> RestResponse:
         actions = req.param("actions", "*")
@@ -1734,6 +1722,10 @@ class _Handlers:
 
     def _multi_index_search(self, names: List[str], body: dict, search_type: str,
                             task=None) -> dict:
+        if task is None:
+            from elasticsearch_tpu.tasks import task_manager as _taskmgr
+
+            task = _taskmgr.current_task()
         responses = [(n, self.node.indices.get(n).search(body, search_type, task=task))
                      for n in names]
         size = int(body.get("size", 10))
@@ -1741,10 +1733,24 @@ class _Handlers:
         all_hits = []
         total = 0
         max_score = None
+        timed_out = False
         shards_total = 0
+        shards_ok = 0
+        shards_skipped = 0
+        shards_failed = 0
+        shard_failures: List[dict] = []
         for name, r in responses:
             total += r["hits"]["total"]["value"]
-            shards_total += r["_shards"]["total"]
+            # a partially-timed-out or partially-failed member index must
+            # not be laundered into a clean merged header (ref:
+            # SearchResponseMerger.java — ORs timeouts, sums shard counts)
+            timed_out = timed_out or bool(r.get("timed_out"))
+            sh = r.get("_shards", {})
+            shards_total += sh.get("total", 0)
+            shards_ok += sh.get("successful", 0)
+            shards_skipped += sh.get("skipped", 0)
+            shards_failed += sh.get("failed", 0)
+            shard_failures.extend(sh.get("failures", []))
             if r["hits"]["max_score"] is not None:
                 max_score = max(max_score or float("-inf"), r["hits"]["max_score"])
             all_hits.extend(r["hits"]["hits"])
@@ -1752,11 +1758,14 @@ class _Handlers:
             all_hits.sort(key=lambda h: h.get("sort", []))
         else:
             all_hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        shards: dict = {"total": shards_total, "successful": shards_ok,
+                        "skipped": shards_skipped, "failed": shards_failed}
+        if shard_failures:
+            shards["failures"] = shard_failures
         return {
             "took": sum(r["took"] for _, r in responses),
-            "timed_out": False,
-            "_shards": {"total": shards_total, "successful": shards_total,
-                        "skipped": 0, "failed": 0},
+            "timed_out": timed_out,
+            "_shards": shards,
             "hits": {"total": {"value": total, "relation": "eq"},
                      "max_score": max_score,
                      "hits": all_hits[from_: from_ + size]},
@@ -1769,7 +1778,10 @@ class _Handlers:
 
         with activate_tier(tier_for_request(req.method, req.path,
                                             req.params)):
-            return self._msearch_inner(req)
+            with self.node.tasks.task(
+                    "indices:data/read/msearch",
+                    f"msearch bytes[{len(req.raw_body)}]"):
+                return self._msearch_inner(req)
 
     def _msearch_inner(self, req: RestRequest) -> RestResponse:
         lines = [ln for ln in req.raw_body.decode().split("\n") if ln.strip()]
@@ -1979,6 +1991,7 @@ class _Handlers:
                 "tpu_durability": _tpu_durability_stats(),
                 "tpu_search_latency": _tpu_search_latency_stats(),
                 "tpu_settings": _tpu_settings_stats(),
+                "tpu_tasks": self.node.tasks.stats(),
                 "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
             }},
         })
@@ -2274,6 +2287,14 @@ class _Handlers:
             rows.append(f"{self.node.node_name} {name} {st['active']} "
                         f"{st['queue']} {st['rejected']} "
                         f"{st['queue_ewma_ms']} {p99}")
+        return RestResponse(body="\n".join(rows) + ("\n" if rows else ""),
+                            content_type="text/plain")
+
+    def cat_tasks(self, req: RestRequest) -> RestResponse:
+        """GET /_cat/tasks — cluster-wide flat task rows via the task
+        plane's fan-out (ref: RestCatTasksAction default columns)."""
+        rows = self.node.task_plane.cat_rows(
+            detailed=req.param_bool("detailed"))
         return RestResponse(body="\n".join(rows) + ("\n" if rows else ""),
                             content_type="text/plain")
 

@@ -1,5 +1,7 @@
 from elasticsearch_tpu.tasks.task_manager import (
-    Task, TaskCancelledError, TaskManager,
+    Task, TaskCancelledError, TaskManager, action_family, activate,
+    current_task,
 )
 
-__all__ = ["Task", "TaskCancelledError", "TaskManager"]
+__all__ = ["Task", "TaskCancelledError", "TaskManager", "action_family",
+           "activate", "current_task"]

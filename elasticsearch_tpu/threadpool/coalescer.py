@@ -34,6 +34,7 @@ from typing import Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common import metrics, tracing
 from elasticsearch_tpu.common.settings import knob
+from elasticsearch_tpu.tasks import task_manager as _taskmgr
 
 DEFAULT_WINDOW_US = 2000.0
 # a query batch larger than this is already a good device shape — merging
@@ -221,6 +222,12 @@ class DispatchCoalescer:
             # a merged dispatch must never fail EVERY waiter because one
             # task was cancelled
             check()
+        ct = _taskmgr.current_task()
+        if ct is not None:
+            # registered-task cancellation (direct or ban-propagated)
+            # honors the same boundary-only contract
+            ct.check()
+            ct.note_dispatch()
         if window_s <= 0 or len(queries) > self.small_batch_max:
             with self._lock:
                 self._direct_dispatches += 1
@@ -290,6 +297,10 @@ class DispatchCoalescer:
                 tc.add_span("coalesce_wait", wait_ms, role="follower")
         if check is not None:
             check()
+        if ct is not None:
+            # a cancel that landed mid-window kills only THIS waiter;
+            # co-batched peers keep their bit-identical slices
+            ct.check()
         if batch.error is not None:
             raise batch.error
         if fault_log is not None and batch.fault_log:

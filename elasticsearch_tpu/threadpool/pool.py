@@ -27,6 +27,7 @@ from typing import Callable, Dict, Optional
 from elasticsearch_tpu.common import metrics, tracing
 from elasticsearch_tpu.common.errors import ElasticsearchTpuError
 from elasticsearch_tpu.common.settings import knob
+from elasticsearch_tpu.tasks import task_manager as _taskmgr
 from elasticsearch_tpu.threadpool import scheduler as _sched
 
 
@@ -49,7 +50,7 @@ class _Task:
     """Submission handle: a tiny future (result or raised error)."""
 
     __slots__ = ("fn", "args", "kwargs", "result", "error", "_done",
-                 "submitted", "trace", "tier")
+                 "submitted", "trace", "tier", "taskref")
 
     def __init__(self, fn, args, kwargs):
         self.fn = fn
@@ -59,11 +60,13 @@ class _Task:
         self.error: Optional[BaseException] = None
         self._done = threading.Event()
         self.submitted = time.monotonic()
-        # the submitter's trace and SLA tier ride the task across the
-        # thread hop and are re-activated in the worker (flight recorder
-        # + scheduler-tier propagation)
+        # the submitter's trace, SLA tier, and registered task ride the
+        # submission across the thread hop and are re-activated in the
+        # worker (flight recorder + scheduler-tier + cancellation
+        # propagation)
         self.trace = tracing.current()
         self.tier = _sched.current_tier()
+        self.taskref = _taskmgr.current_task()
 
     def run(self) -> None:
         try:
@@ -154,7 +157,8 @@ class FixedExecutor:
             if task.trace is not None:
                 task.trace.add_span(f"queue_wait.{self.name}", qw_ms)
             with tracing.activate(task.trace), \
-                    _sched.activate_tier(task.tier):
+                    _sched.activate_tier(task.tier), \
+                    _taskmgr.activate(task.taskref):
                 task.run()
             dt_ms = (time.monotonic() - t0) * 1e3
             with self._lock:
@@ -284,3 +288,52 @@ class ThreadPool:
     def shutdown(self) -> None:
         for ex in self.executors.values():
             ex.shutdown()
+
+
+# ---- hot threads (ref: monitor/jvm/HotThreads.java two-sample diff) ----
+
+def _format_stack(frame, max_frames: int) -> list:
+    import traceback
+
+    return ["     " + ln for ln in traceback.format_stack(frame)[-max_frames:]]
+
+
+def _is_parked_pool_stack(stack: list) -> bool:
+    """An es-tpu pool worker blocked in its queue wait contributes
+    nothing to a hot-threads reading — same filtering the reference
+    applies to idle threadpool threads."""
+    tail = "".join(stack[-3:])
+    return "_worker" in tail and ("self._work.wait()" in tail
+                                  or "waiter.acquire()" in tail)
+
+
+def hot_threads_report(node_label: str,
+                       interval_ms: Optional[float] = None,
+                       max_frames: int = 12) -> str:
+    """One node's hot_threads section: two stack samples `interval_ms`
+    apart; a thread whose stack CHANGED between samples is hot, an
+    es-tpu pool worker parked in its queue wait across both samples is
+    dropped, and everything else prints as idle for context."""
+    import sys
+
+    if interval_ms is None:
+        interval_ms = float(knob("ES_TPU_HOT_THREADS_INTERVAL_MS"))
+    names = {t.ident: t.name for t in threading.enumerate()}
+    first = {tid: _format_stack(f, max_frames)
+             for tid, f in sys._current_frames().items()}
+    time.sleep(max(0.0, float(interval_ms)) / 1000.0)
+    second = {tid: _format_stack(f, max_frames)
+              for tid, f in sys._current_frames().items()}
+    out = [f"::: {node_label}",
+           f"   interval={interval_ms:g}ms, "
+           f"sampled {len(second)} threads:"]
+    for tid, stack in sorted(second.items()):
+        name = names.get(tid, str(tid))
+        pooled = str(name).startswith("es-tpu[")
+        changed = first.get(tid) != stack
+        if pooled and not changed and _is_parked_pool_stack(stack):
+            continue
+        state = "hot" if changed else "idle"
+        out.append(f"\n   {state} thread [{name}] id [{tid}]:")
+        out.extend(ln.rstrip("\n") for ln in stack)
+    return "\n".join(out) + "\n"

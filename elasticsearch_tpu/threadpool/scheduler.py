@@ -55,6 +55,7 @@ from typing import Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.common import metrics, tracing
 from elasticsearch_tpu.common.settings import knob
+from elasticsearch_tpu.tasks import task_manager as _taskmgr
 from elasticsearch_tpu.threadpool.coalescer import (
     SMALL_BATCH_MAX, DispatchCoalescer, _engine_key, default_coalescer,
     record_device, retry_batch_solo,
@@ -267,6 +268,12 @@ class AdaptiveDispatchScheduler:
             # merged dispatch must never fail EVERY waiter because one
             # task was cancelled
             check()
+        ct = _taskmgr.current_task()
+        if ct is not None:
+            # registered-task cancellation (direct or ban-propagated)
+            # honors the same boundary-only contract
+            ct.check()
+            ct.note_dispatch()
         if knob("ES_TPU_COALESCE_US") <= 0 \
                 or len(queries) > self.small_batch_max:
             with self._lock:
@@ -301,6 +308,11 @@ class AdaptiveDispatchScheduler:
         try:
             if check is not None:
                 check()
+            if ct is not None:
+                # a ban that landed while we were parked in the batch
+                # kills only THIS waiter; co-batched peers keep their
+                # bit-identical slices
+                ct.check()
             if batch.error is not None:
                 raise batch.error
             if fault_log is not None and batch.fault_log:

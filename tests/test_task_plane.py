@@ -1,0 +1,359 @@
+"""Cluster task plane (PR 11): cross-node task trees, ban-propagated
+cancellation, hot-threads fan-out, partial answers over dead peers.
+
+Runs on the deterministic in-process harness (LocalNodeChannels): every
+fan-out, ban, and reap crosses the same transport the data path uses.
+"""
+
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster_node import form_local_cluster
+from elasticsearch_tpu.common.errors import (
+    ElasticsearchTpuError, IllegalArgumentError,
+)
+from elasticsearch_tpu.tasks import TaskCancelledError
+
+MAPPINGS = {"properties": {"body": {"type": "text"},
+                           "n": {"type": "integer"}}}
+
+
+def two_nodes(data_path=None):
+    return form_local_cluster(["a", "b"], data_path=data_path)
+
+
+def fill(node, index="docs", shards=2, docs=40):
+    node.create_index(index, {
+        "settings": {"number_of_shards": shards, "number_of_replicas": 0},
+        "mappings": MAPPINGS})
+    node.bulk(index, [{"op": "index", "id": str(i),
+                       "source": {"body": f"w{i % 5} common", "n": i}}
+                      for i in range(docs)])
+    node.refresh(index)
+
+
+class _SlowShard:
+    """Stalls node `b`'s shard-query handler until released, signalling
+    when the first query arrives — a deterministic in-flight window."""
+
+    def __init__(self, node, hold_s=6.0):
+        self.node = node
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.hold_s = hold_s
+        self._orig = node.search_action._shard_query_inner
+
+    def __enter__(self):
+        orig = self._orig
+
+        def slow(req):
+            self.entered.set()
+            self.release.wait(self.hold_s)
+            return orig(req)
+
+        self.node.search_action._shard_query_inner = slow
+        return self
+
+    def __exit__(self, *exc):
+        self.release.set()
+        self.node.search_action._shard_query_inner = self._orig
+
+
+def _search_bg(node, index="docs", body=None):
+    out = {}
+
+    def run():
+        try:
+            out["r"] = node.search(index, body or {
+                "query": {"match": {"body": "common"}}, "size": 5})
+        except BaseException as e:  # noqa: BLE001 — asserted by callers
+            out["e"] = e
+
+    t = threading.Thread(target=run)
+    t.start()
+    return t, out
+
+
+def test_cross_node_tree_detailed_with_trace_linkage():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a)
+    with _SlowShard(b) as slow:
+        # profile forces the flight recorder on: the tree must carry the
+        # coordinator's trace id down to every remote shard child
+        t, out = _search_bg(a, body={
+            "query": {"match": {"body": "common"}}, "size": 5,
+            "profile": True})
+        assert slow.entered.wait(5)
+        listing = a.task_plane.list(detailed=True)
+        slow.release.set()
+        t.join(timeout=30)
+    assert "e" not in out
+    tasks = {tid: d for sec in listing["nodes"].values()
+             for tid, d in sec["tasks"].items()}
+    parents = {tid: d for tid, d in tasks.items()
+               if d["action"] == "indices:data/read/search"
+               and d.get("parent_task_id") is None}
+    assert len(parents) == 1
+    ptid, parent = next(iter(parents.items()))
+    assert ptid.startswith("a:")
+    children = {tid: d for tid, d in tasks.items()
+                if d.get("parent_task_id") == ptid}
+    # node b's shard-query child is linked to node a's coordinator
+    assert any(tid.startswith("b:") for tid in children)
+    for d in children.values():
+        assert d["action"].startswith("indices:data/read/search[phase/")
+        assert d["headers"]["trace_id"] == parent["headers"]["trace_id"]
+        assert d["status"]["phase"] in ("query", "fetch")
+    assert parent["running_time_in_nanos"] > 0
+    assert parent["cancellable"] is True
+
+
+def test_group_by_parents_nests_remote_children():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a)
+    with _SlowShard(b) as slow:
+        t, out = _search_bg(a)
+        assert slow.entered.wait(5)
+        listing = a.task_plane.list(detailed=True, group_by="parents")
+        flat = a.task_plane.list(group_by="none")
+        slow.release.set()
+        t.join(timeout=30)
+    assert "e" not in out
+    roots = listing["tasks"]
+    parent = next(d for d in roots.values()
+                  if d.get("parent_task_id") is None)
+    kids = parent.get("children", [])
+    assert any(d["node"] == "b" for d in kids)
+    assert isinstance(flat["tasks"], list) and len(flat["tasks"]) >= 2
+
+
+def test_list_filters_actions_nodes_and_parent():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a)
+    with _SlowShard(b) as slow:
+        t, out = _search_bg(a)
+        assert slow.entered.wait(5)
+        only_b = a.task_plane.list(nodes="b")
+        only_search = a.task_plane.list(actions="indices:data/read/search")
+        parent_tid = next(
+            tid for sec in a.task_plane.list()["nodes"].values()
+            for tid, d in sec["tasks"].items()
+            if d.get("parent_task_id") is None)
+        by_parent = a.task_plane.list(parent_task_id=parent_tid)
+        slow.release.set()
+        t.join(timeout=30)
+    assert "e" not in out
+    assert set(only_b["nodes"]) == {"b"}
+    for sec in only_search["nodes"].values():
+        for d in sec["tasks"].values():
+            assert d["action"] == "indices:data/read/search"
+    for sec in by_parent["nodes"].values():
+        for d in sec["tasks"].values():
+            assert d["parent_task_id"] == parent_tid
+
+
+def test_dead_node_yields_partial_list_with_node_failures():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    channels.kill("b")
+    listing = a.task_plane.list()
+    assert set(listing["nodes"]) == {"a"}
+    fails = listing["node_failures"]
+    assert [f["node_id"] for f in fails] == ["b"]
+    assert fails[0]["type"] == "failed_node_exception"
+    assert fails[0]["caused_by"]["type"] == "node_not_connected_exception"
+    channels.revive("b")
+    assert "node_failures" not in a.task_plane.list()
+
+
+def test_task_id_routing_cross_node():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    t = b.tasks.register("indices:data/read/search", "remote probe")
+    got = a.task_plane.get(f"b:{t.id}")          # routed to the owner
+    assert got["task"]["description"] == "remote probe"
+    assert got["task"]["node"] == "b"
+    with pytest.raises(IllegalArgumentError):
+        a.task_plane.get("zzz:notanum")           # malformed: 400 first
+    with pytest.raises(ElasticsearchTpuError) as ei:
+        a.task_plane.get("ghost:123")             # unknown node: 404
+    assert ei.value.status == 404
+    channels.kill("b")
+    with pytest.raises(ElasticsearchTpuError) as ei:
+        a.task_plane.get(f"b:{t.id}")             # dead node: 404
+    assert ei.value.status == 404
+    channels.revive("b")
+    b.tasks.unregister(t)
+
+
+def test_cross_node_cancel_bans_children_within_one_boundary():
+    """The acceptance criterion: cancelling the coordinator on node a
+    kills node b's shard child at its next dispatch boundary, and the ban
+    cancels a not-yet-registered child on arrival."""
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a)
+    with _SlowShard(b) as slow:
+        t, out = _search_bg(a)
+        assert slow.entered.wait(5)
+        parent_tid = next(
+            tid for sec in a.task_plane.list()["nodes"].values()
+            for tid, d in sec["tasks"].items()
+            if d.get("parent_task_id") is None)
+        resp = a.task_plane.cancel(parent_tid, reason="test cancel")
+        assert parent_tid in resp["nodes"]["a"]["tasks"]
+        # the ban crossed the wire before the child's next boundary
+        assert b.tasks.stats()["bans_received"] == 1
+        banned_children = [d for d in b.tasks.list()
+                           if d.parent_task_id == parent_tid]
+        assert all(c.is_cancelled for c in banned_children)
+        slow.release.set()
+        t.join(timeout=30)
+    assert isinstance(out.get("e"), TaskCancelledError)
+    assert a.tasks.stats()["bans_propagated"] >= 1
+    # ban-on-arrival: a racing child registering AFTER the cancel reaches
+    # node b is born cancelled (TaskCancellationService semantics)
+    late = b.tasks.register("indices:data/read/search[phase/query]",
+                            parent_task_id=parent_tid)
+    assert late.is_cancelled
+    b.tasks.unregister(late)
+
+
+def test_cancel_wait_for_completion_drains_descendants():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a)
+    with _SlowShard(b, hold_s=0.3) as slow:
+        t, out = _search_bg(a)
+        assert slow.entered.wait(5)
+        parent_tid = next(
+            tid for sec in a.task_plane.list()["nodes"].values()
+            for tid, d in sec["tasks"].items()
+            if d.get("parent_task_id") is None)
+        a.task_plane.cancel(parent_tid, wait_for_completion=True,
+                            timeout_ms=5000)
+        # after the drain returns no descendant survives anywhere
+        for node in (a, b):
+            assert not [x for x in node.tasks.list()
+                        if x.parent_task_id == parent_tid]
+        t.join(timeout=30)
+    assert isinstance(out.get("e"), TaskCancelledError)
+
+
+def test_node_left_reaps_orphans_by_ban():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    orphan = b.tasks.register("indices:data/read/search[phase/query]",
+                              parent_task_id="c:42")
+    a.task_plane.broadcast_reap("c")
+    assert orphan.is_cancelled
+    assert b.tasks.stats()["orphans_reaped"] == 1
+    # the node-wide ban also kills late registrations from the dead node
+    late = b.tasks.register("indices:data/read/search[phase/fetch]",
+                            parent_task_id="c:7")
+    assert late.is_cancelled
+    b.tasks.unregister(orphan)
+    b.tasks.unregister(late)
+
+
+def test_cancelled_round_leaves_identical_rerun():
+    """No-cancel purity at cluster level: after a cancelled search, an
+    identical fresh search returns exactly what a quiet cluster returns."""
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a)
+    body = {"query": {"match": {"body": "common"}}, "size": 10,
+            "track_total_hits": True}
+    quiet = a.search("docs", body)
+    with _SlowShard(b) as slow:
+        t, out = _search_bg(a, body=body)
+        assert slow.entered.wait(5)
+        parent_tid = next(
+            tid for sec in a.task_plane.list()["nodes"].values()
+            for tid, d in sec["tasks"].items()
+            if d.get("parent_task_id") is None)
+        a.task_plane.cancel(parent_tid)
+        slow.release.set()
+        t.join(timeout=30)
+    assert isinstance(out.get("e"), TaskCancelledError)
+    rerun = a.search("docs", body)
+    assert rerun["hits"] == quiet["hits"]
+    assert rerun["_shards"] == quiet["_shards"]
+
+
+def test_hot_threads_fans_out_and_reports_dead_peers():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    report = a.task_plane.hot_threads()
+    assert "::: {a}" in report and "::: {b}" in report
+    assert "thread [" in report
+    channels.kill("b")
+    partial = a.task_plane.hot_threads()
+    assert "::: {a}" in partial
+    assert "failed to fetch hot_threads" in partial
+    channels.revive("b")
+
+
+def test_cat_tasks_rows_cover_cluster():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a)
+    with _SlowShard(b) as slow:
+        t, out = _search_bg(a)
+        assert slow.entered.wait(5)
+        rows = a.task_plane.cat_rows()
+        slow.release.set()
+        t.join(timeout=30)
+    assert "e" not in out
+    assert any("indices:data/read/search " in r and " a" in r for r in rows)
+    assert any(r.startswith("indices:data/read/search[phase/")
+               for r in rows)
+
+
+def test_bulk_registers_coordinator_and_shard_children():
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a, docs=4)
+    before_b = b.tasks.stats()["registered"]
+    before_a = a.tasks.stats()["registered"]
+    a.bulk("docs", [{"op": "index", "id": f"x{i}",
+                     "source": {"body": "late", "n": 100 + i}}
+                    for i in range(8)])
+    assert a.tasks.stats()["registered"] > before_a
+    # node b holds one of the two shards: its bulk child registered there
+    assert b.tasks.stats()["registered"] > before_b
+    assert not a.tasks.list() and not b.tasks.list()   # all drained
+
+
+def test_running_time_is_monotonic_and_wall_clock_start():
+    nodes, _, _ = two_nodes()
+    a, _b = nodes
+    t = a.tasks.register("indices:data/read/search", "clock probe")
+    wall = time.time() * 1000
+    d1 = t.to_dict()
+    time.sleep(0.02)
+    d2 = t.to_dict()
+    assert d2["running_time_in_nanos"] > d1["running_time_in_nanos"]
+    assert d1["running_time_in_nanos"] >= 0
+    assert abs(d1["start_time_in_millis"] - wall) < 60_000
+    a.tasks.unregister(t)
+
+
+def test_task_duration_histogram_and_stats_sections():
+    from elasticsearch_tpu.common import metrics
+
+    nodes, store, channels = two_nodes()
+    a, b = nodes
+    fill(a)
+    a.search("docs", {"query": {"match": {"body": "common"}}})
+    s = metrics.summary("task_duration.search")
+    assert s and s["count"] >= 1
+    st = a.tasks.stats()
+    for key in ("registered", "completed", "cancelled", "bans_propagated",
+                "bans_received", "orphans_reaped", "bans_active", "current"):
+        assert key in st
