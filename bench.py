@@ -112,6 +112,12 @@ def emit(partial: bool) -> None:
     _emitted = True
     RESULT["detail"]["partial"] = partial
     RESULT["detail"]["elapsed_s"] = round(time.time() - T_START, 1)
+    try:
+        from elasticsearch_tpu.common import hbm_ledger
+        RESULT["detail"]["tpu_hbm"] = hbm_ledger.hbm_stats()
+        RESULT["detail"]["tpu_compile"] = hbm_ledger.compile_stats()
+    except Exception:  # noqa: BLE001 — telemetry must never block the emit
+        pass
     print(json.dumps(RESULT), flush=True)
 
 
@@ -1378,6 +1384,80 @@ def dryrun_tasks() -> int:
     return 0 if ok else 1
 
 
+def dryrun_metrics() -> int:
+    """Telemetry-plane smoke (PR 12): single-node CPU run asserting the
+    metrics loop end to end — GET /_tpu/metrics renders a well-formed
+    Prometheus document covering every declared counter/gauge/histogram,
+    `_nodes/stats` carries the tpu_hbm/tpu_compile sections, and a manual
+    sample lands in GET /_tpu/metrics/history. One JSON line on stdout;
+    exit 0/1."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.common import hbm_ledger, metrics
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import RestController, register_handlers
+
+    metrics.reset_for_tests()
+    hbm_ledger.reset_for_tests()
+    log("dryrun_metrics: starting single-node REST smoke...")
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+
+    def call(method, path, body=None, params=None):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body)
+        return rc.dispatch(method, path, params or {}, body)
+
+    try:
+        call("PUT", "/flight", {
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        for i in range(16):
+            call("PUT", f"/flight/_doc/{i}",
+                 {"body": f"hello world doc{i}"})
+        call("POST", "/flight/_refresh")
+        call("POST", "/flight/_search",
+             {"query": {"match": {"body": "hello"}}})
+        metrics.sample_now()
+        m = call("GET", "/_tpu/metrics")
+        text = m.body if isinstance(m.body, str) else ""
+        samples = [ln for ln in text.splitlines()
+                   if ln and not ln.startswith("#")]
+        malformed = [ln for ln in samples if " " not in ln]
+        wanted = ([metrics._prom_name(n) + "_total"
+                   for n in metrics.DECLARED_COUNTERS]
+                  + [metrics._prom_name(n) for n in metrics.DECLARED_GAUGES]
+                  + [metrics._prom_name(n) for n in metrics.DECLARED])
+        covered = all(f"# TYPE {n} " in text for n in wanted)
+        st = call("GET", "/_nodes/stats").body
+        sec = next(iter(st["nodes"].values()))
+        hbm = sec.get("tpu_hbm") or {}
+        comp = sec.get("tpu_compile") or {}
+        hist = call("GET", "/_tpu/metrics/history").body
+    finally:
+        node.close()
+    ok = (m.status == 200
+          and str(m.content_type).startswith("text/plain")
+          and 'es_tpu_node_up{node="' in text
+          and not malformed and covered
+          and hbm.get("occupancy_bytes", -1) >= 0
+          and "warmup_coverage_ratio" in comp
+          and len(hist.get("samples", [])) >= 1)
+    print(json.dumps({
+        "metric": "dryrun_metrics",
+        "ok": bool(ok),
+        "exposition_lines": len(samples),
+        "declared_covered": bool(covered),
+        "occupancy_bytes": int(hbm.get("occupancy_bytes", -1)),
+        "compile_misses": int(comp.get("misses", 0)),
+        "history_samples": len(hist.get("samples", [])),
+    }), flush=True)
+    log(f"dryrun_metrics: lines={len(samples)} covered={covered}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -1400,4 +1480,7 @@ if __name__ == "__main__":
     if "dryrun_tasks" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_tasks":
         sys.exit(dryrun_tasks())
+    if "dryrun_metrics" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_metrics":
+        sys.exit(dryrun_metrics())
     main()

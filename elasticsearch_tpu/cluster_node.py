@@ -88,6 +88,15 @@ class ClusterNode:
         self.task_plane = TaskPlane(
             self.tasks, node_name, channels=channels,
             state_fn=lambda: self.state, transport=self.transport)
+        from elasticsearch_tpu.cluster.telemetry_plane import TelemetryPlane
+        from elasticsearch_tpu.common import metrics as _metrics
+
+        # cluster telemetry plane: answers nodes-stats / metrics-scrape
+        # RPCs for coordinators and fans out when acting as one
+        self.telemetry_plane = TelemetryPlane(
+            node_name, channels=channels,
+            state_fn=lambda: self.state, transport=self.transport)
+        _metrics.maybe_start_sampler()
         self.shard_service = DistributedShardService(
             node_name, self.transport, channels, self.master_client,
             data_path, indexing_pressure=self.indexing_pressure,

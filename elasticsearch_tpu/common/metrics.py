@@ -16,9 +16,13 @@ Design constraints:
 
 from __future__ import annotations
 
+import re
 import threading
+import time
 from bisect import bisect_left
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from elasticsearch_tpu.common.settings import knob
 
 
 def _log_ms_bounds() -> Tuple[float, ...]:
@@ -186,8 +190,222 @@ def raw_dump(name: str) -> dict:
 
 
 def reset_for_tests() -> None:
+    _SAMPLER_STOP.set()
     with _REG_LOCK:
         _LIVE.clear()
+        _COUNTERS.clear()
+        _GAUGES.clear()
+    with _SAMPLE_LOCK:
+        _SAMPLES.clear()
+
+
+# --- counters & gauges (device telemetry plane, PR 12) -----------------------
+# Scalar companions to the histograms above, with the same declare-first
+# discipline: counters are monotonic totals (rates come from sampler-ring
+# deltas), gauges are point-in-time levels. Gauges declared OUTSIDE this
+# registry (common/hbm_ledger.py) must surface in the declaring module's
+# stats() function — tpulint TPU005 enforces that, exactly like it ties
+# observe() sites to declare_histogram.
+
+DECLARED_COUNTERS: Dict[str, str] = {}  # name -> doc; import-time only
+DECLARED_GAUGES: Dict[str, str] = {}    # name -> doc; import-time only
+_COUNTERS: Dict[str, float] = {}        # guarded by: _REG_LOCK
+_GAUGES: Dict[str, float] = {}          # guarded by: _REG_LOCK
+
+
+class UndeclaredMetricError(KeyError):
+    pass
+
+
+def declare_counter(name: str, doc: str) -> None:
+    DECLARED_COUNTERS[name] = doc
+
+
+def declare_gauge(name: str, doc: str) -> None:
+    DECLARED_GAUGES[name] = doc
+
+
+def counter_add(name: str, delta: float = 1.0) -> None:
+    if name not in DECLARED_COUNTERS:
+        raise UndeclaredMetricError(f"counter {name!r} is not declared")
+    with _REG_LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0.0) + float(delta)
+
+
+def gauge_set(name: str, value: float) -> None:
+    if name not in DECLARED_GAUGES:
+        raise UndeclaredMetricError(f"gauge {name!r} is not declared")
+    with _REG_LOCK:
+        _GAUGES[name] = float(value)
+
+
+def counter_values() -> Dict[str, float]:
+    """Every declared counter (unbumped ones read 0 so scrapes and rate
+    computations never see a metric appear out of nowhere)."""
+    with _REG_LOCK:
+        return {n: _COUNTERS.get(n, 0.0) for n in DECLARED_COUNTERS}
+
+
+def gauge_values() -> Dict[str, float]:
+    with _REG_LOCK:
+        return {n: _GAUGES.get(n, 0.0) for n in DECLARED_GAUGES}
+
+
+# node-level scheduler occupancy, pushed by threadpool/scheduler.py as
+# dispatch slots are taken/released; the sampler ring below turns them
+# into busy fractions and flush rates without an external scraper
+declare_gauge("sched_inflight",
+              "device batches currently in flight across scheduler lanes")
+declare_gauge("sched_lanes", "live (engine, k) scheduler lanes")
+declare_counter("sched_flushes",
+                "adaptive-scheduler batch flushes (sampler-ring deltas "
+                "give the flush rate)")
+
+
+# --- Prometheus text exposition ----------------------------------------------
+
+_PROM_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "es_tpu_" + _PROM_SANITIZE.sub("_", name)
+
+
+def _prom_num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def scrape_payload() -> dict:
+    """One node's full metric state in mergeable form — what the
+    /_tpu/metrics fan-out RPC returns per node."""
+    return {"counters": counter_values(), "gauges": gauge_values(),
+            "histograms": {name: raw_dump(name) for name in DECLARED}}
+
+
+def render_prometheus(per_node: Dict[str, dict],
+                      failures: Sequence[dict] = ()) -> str:
+    """Prometheus text exposition over per-node ``scrape_payload`` dumps.
+
+    Every declared counter, gauge, and histogram renders for every live
+    node (one ``node`` label per sample; histograms in cumulative-``le``
+    bucket form against the kind's fixed bounds). Dead peers degrade to
+    ``es_tpu_node_up 0`` rows instead of failing the scrape — the PR 6/11
+    partial-answer contract in exposition-format clothing."""
+    out: List[str] = []
+    nodes = sorted(per_node)
+    out.append("# HELP es_tpu_node_up 1 when the node answered the metrics "
+               "fan-out, 0 when it degraded to a node_failures entry")
+    out.append("# TYPE es_tpu_node_up gauge")
+    for n in nodes:
+        out.append(f'es_tpu_node_up{{node="{n}"}} 1')
+    for f in failures:
+        out.append(f'es_tpu_node_up{{node="{f.get("node_id")}"}} 0')
+    for name in sorted(DECLARED_COUNTERS):
+        m = _prom_name(name) + "_total"
+        out.append(f"# HELP {m} {DECLARED_COUNTERS[name]}")
+        out.append(f"# TYPE {m} counter")
+        for n in nodes:
+            v = per_node[n].get("counters", {}).get(name, 0.0)
+            out.append(f'{m}{{node="{n}"}} {_prom_num(v)}')
+    for name in sorted(DECLARED_GAUGES):
+        m = _prom_name(name)
+        out.append(f"# HELP {m} {DECLARED_GAUGES[name]}")
+        out.append(f"# TYPE {m} gauge")
+        for n in nodes:
+            v = per_node[n].get("gauges", {}).get(name, 0.0)
+            out.append(f'{m}{{node="{n}"}} {_prom_num(v)}')
+    for name in sorted(DECLARED):
+        kind, doc = DECLARED[name]
+        m = _prom_name(name)
+        bounds = _BOUNDS_BY_KIND[kind]
+        out.append(f"# HELP {m} {doc}")
+        out.append(f"# TYPE {m} histogram")
+        for n in nodes:
+            raw = per_node[n].get("histograms", {}).get(name)
+            counts = raw["counts"] if raw else [0] * (len(bounds) + 1)
+            acc = 0
+            for b, c in zip(bounds, counts):
+                acc += c
+                out.append(f'{m}_bucket{{node="{n}",le="{b:g}"}} {acc}')
+            total_n = raw["count"] if raw else 0
+            out.append(f'{m}_bucket{{node="{n}",le="+Inf"}} {total_n}')
+            out.append(f'{m}_sum{{node="{n}"}} '
+                       f'{_prom_num(raw["total"] if raw else 0.0)}')
+            out.append(f'{m}_count{{node="{n}"}} {total_n}')
+    return "\n".join(out) + "\n"
+
+
+# --- periodic sampler ring (ES_TPU_METRICS_SAMPLE_S) -------------------------
+# Rates need two points in time. Rather than requiring an external scraper,
+# an optional background thread snapshots every declared counter/gauge (plus
+# any registered provider sections, e.g. the scheduler's per-lane inflight
+# occupancy) into a bounded ring served at GET /_tpu/metrics/history.
+
+_SAMPLE_LOCK = threading.Lock()
+_SAMPLES: List[dict] = []                                # guarded by: _SAMPLE_LOCK
+_SAMPLE_PROVIDERS: Dict[str, Callable[[], dict]] = {}    # guarded by: _SAMPLE_LOCK
+_SAMPLER_THREAD: Optional[threading.Thread] = None       # guarded by: _SAMPLE_LOCK
+_SAMPLER_STOP = threading.Event()
+
+
+def register_sample_provider(name: str, fn: Callable[[], dict]) -> None:
+    """Attach a named section to every sample (idempotent per name)."""
+    with _SAMPLE_LOCK:
+        _SAMPLE_PROVIDERS[name] = fn
+
+
+def sample_now() -> dict:
+    """Take one snapshot and append it to the ring (also the sampler
+    thread's tick body — callable directly so tests and bench dryruns
+    don't need a live thread)."""
+    with _SAMPLE_LOCK:
+        providers = dict(_SAMPLE_PROVIDERS)
+    s: dict = {"ts": time.time(), "counters": counter_values(),
+               "gauges": gauge_values()}
+    for name, fn in sorted(providers.items()):
+        try:
+            s[name] = fn()
+        except Exception:   # noqa: BLE001 — a broken provider must not
+            s[name] = None  # kill the sampler
+    cap = max(1, int(knob("ES_TPU_METRICS_HISTORY")))
+    with _SAMPLE_LOCK:
+        _SAMPLES.append(s)
+        del _SAMPLES[: max(0, len(_SAMPLES) - cap)]
+    return s
+
+
+def metrics_history() -> List[dict]:
+    with _SAMPLE_LOCK:
+        return list(_SAMPLES)
+
+
+def _sampler_loop() -> None:
+    global _SAMPLER_THREAD
+    while True:
+        period = float(knob("ES_TPU_METRICS_SAMPLE_S"))
+        if period <= 0 or _SAMPLER_STOP.wait(period):
+            break
+        sample_now()
+    with _SAMPLE_LOCK:
+        _SAMPLER_THREAD = None
+
+
+def maybe_start_sampler() -> bool:
+    """Start the background sampler when ES_TPU_METRICS_SAMPLE_S > 0.
+    Idempotent; returns whether a sampler is (now) running. The knob is
+    re-read every tick, so setting it to 0 retires the thread."""
+    global _SAMPLER_THREAD
+    if float(knob("ES_TPU_METRICS_SAMPLE_S")) <= 0:
+        return False
+    with _SAMPLE_LOCK:
+        if _SAMPLER_THREAD is not None:
+            return True
+        _SAMPLER_STOP.clear()
+        _SAMPLER_THREAD = threading.Thread(
+            target=_sampler_loop, daemon=True, name="es-tpu-metrics-sampler")
+        _SAMPLER_THREAD.start()
+    return True
 
 
 # --- phase histograms (the flight recorder's standing distributions) --------

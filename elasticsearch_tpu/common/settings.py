@@ -408,6 +408,14 @@ declare_knob("ES_TPU_TASK_FANOUT_TIMEOUT_MS", "int", 2000,
 declare_knob("ES_TPU_HOT_THREADS_INTERVAL_MS", "int", 15,
              "Sleep between the two stack samples of a hot_threads "
              "capture (threads idle across both samples are filtered)")
+# device telemetry plane (PR 12)
+declare_knob("ES_TPU_METRICS_SAMPLE_S", "float", 0.0,
+             "Period of the background metrics sampler in seconds: every "
+             "tick snapshots counters/gauges into the history ring served "
+             "at GET /_tpu/metrics/history (0 = sampler off)")
+declare_knob("ES_TPU_METRICS_HISTORY", "int", 120,
+             "Capacity of the in-memory metrics-sample ring (oldest "
+             "samples drop first)")
 
 
 class ClusterSettings:

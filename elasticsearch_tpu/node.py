@@ -88,6 +88,13 @@ class Node:
         self.task_plane = TaskPlane(
             self.tasks, self.node_name,
             hot_label=f"{{{self.node_name}}}{{{self.node_id}}}")
+        from elasticsearch_tpu.cluster.telemetry_plane import TelemetryPlane
+        from elasticsearch_tpu.common import metrics as _metrics
+
+        # standalone telemetry plane: local-only stats/scrape; the REST
+        # handlers install a richer local_stats_fn (rest/handlers.py)
+        self.telemetry_plane = TelemetryPlane(self.node_name)
+        _metrics.maybe_start_sampler()
         self._async_searches: Dict[str, dict] = {}
         from elasticsearch_tpu.ingest import IngestService
 

@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from elasticsearch_tpu.common import faults
+from elasticsearch_tpu.common import faults, hbm_ledger
 from elasticsearch_tpu.common.health import EngineHealth
 from elasticsearch_tpu.parallel.compat import SHARD_MAP_RETRACE_SAFE, shard_map
 from elasticsearch_tpu.ops import bm25_idf, next_bucket
@@ -121,6 +121,13 @@ class BlockMaxBM25:
         # fallback)
         self.health = EngineHealth("blockmax")
         self._build_hot_columns()
+        # HBM residency ledger: regions mirror hbm_bytes() exactly
+        self._hbm = hbm_ledger.register_engine(
+            self, "blockmax", devices=len(mesh.devices.flat))
+        self._hbm.set_region("block_docs", stacked.block_docs.nbytes)
+        self._hbm.set_region("block_scores", stacked.block_scores.nbytes)
+        self._hbm.set_region("live", stacked.live.nbytes)
+        self._hbm.set_region("hot_cols", self.hot_cols.nbytes)
 
     # ---------------- build ----------------
 
@@ -449,6 +456,11 @@ class BlockMaxBM25:
                 if check is not None:
                     check()
                 W, qb, qi_ = self._assemble(chunk, sels, bucket)
+                # compile telemetry: (block bucket, padded Qc, program
+                # flavor) pins the compiled shape
+                shape_key = (bucket, qc, "hot" if has_hot else "lane")
+                first_trace = hbm_ledger.note_dispatch("blockmax", shape_key)
+                tb0 = _time.monotonic()
                 if has_hot:
                     packed_b = _hybrid_program(
                         self.stacked.block_docs, self.stacked.block_scores,
@@ -461,6 +473,9 @@ class BlockMaxBM25:
                         self.stacked.live,
                         jnp.asarray(qb), jnp.asarray(qi_),
                         mesh=self.mesh, k=k)
+                if first_trace:
+                    hbm_ledger.note_compile_done(
+                        "blockmax", shape_key, _time.monotonic() - tb0)
                 pending.append((idxs, packed_b))
         t4 = _time.monotonic()
         timing["assemble_dispatch_b"] = t4 - t3

@@ -28,6 +28,7 @@ contribute nothing (see ops/scoring.py).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from functools import partial
 from typing import Dict, List, Sequence, Tuple
@@ -37,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from elasticsearch_tpu.common import hbm_ledger
 from elasticsearch_tpu.parallel.compat import shard_map
 from elasticsearch_tpu.index.segment import FieldPostings, Segment
 from elasticsearch_tpu.ops import BLOCK, bm25_idf, next_bucket
@@ -731,14 +733,31 @@ class Bm25ColumnCache:
             jnp.zeros((S, capacity + 1, D), jnp.float32),
             NamedSharding(mesh, P("shard")),
         )
-        self.term_slot: Dict[str, int] = {}
-        self.term_idf: Dict[str, float] = {}
-        self._lru: Dict[str, int] = {}   # term -> tick
-        self._tick = 0
-        self._free = list(range(capacity))
+        # slot-pool state shared between concurrent ensure_terms callers:
+        # the protect-set read in _evict and the churn accounting must see
+        # a consistent pool, or an in-flight batch's slots can be freed
+        # under it (PR 12 satellite fix)
+        self._lock = threading.Lock()
+        self.term_slot: Dict[str, int] = {}   # guarded by: _lock
+        self.term_idf: Dict[str, float] = {}  # guarded by: _lock
+        self._lru: Dict[str, int] = {}        # guarded by: _lock (term -> tick)
+        self._tick = 0                        # guarded by: _lock
+        self._free = list(range(capacity))    # guarded by: _lock
+        self._slot_bytes = self.cache.nbytes // (capacity + 1)
+        self._hbm = hbm_ledger.register_engine(
+            self, "spmd_cache", devices=len(mesh.devices.flat))
+        self._hbm.set_region("cache", self.cache.nbytes)
 
-    def _evict(self, n: int, protect: set) -> List[int]:
-        """Free the n least-recently-used slots, never evicting `protect`."""
+    def hbm_bytes(self) -> int:
+        return self.cache.nbytes
+
+    def _evict(self, n: int, protect: set) -> List[int]:  # tpulint: holds=_lock
+        """Free the n least-recently-used slots, never evicting `protect`.
+
+        Caller holds _lock: the protect set and the LRU order are read,
+        and the churn counters bumped, under the same critical section —
+        so a concurrent batch can neither free slots this batch's fused
+        dispatch still reads nor observe a half-updated pool."""
         victims = [t for t in sorted(self._lru, key=self._lru.get) if t not in protect][:n]
         if len(victims) < n:
             raise ValueError(
@@ -748,10 +767,16 @@ class Bm25ColumnCache:
             slots.append(self.term_slot.pop(t))
             del self.term_idf[t]
             del self._lru[t]
+        self._hbm.note_eviction(count=len(victims),
+                                freed_bytes=self._slot_bytes * len(victims))
         return slots
 
     def ensure_terms(self, terms: Sequence[str]) -> None:
         """Build + insert impact columns for terms not yet cached."""
+        with self._lock:
+            self._ensure_terms_locked(terms)
+
+    def _ensure_terms_locked(self, terms: Sequence[str]) -> None:  # tpulint: holds=_lock
         batch_terms = set(terms)
         missing = [t for t in dict.fromkeys(terms) if t not in self.term_slot]
         self._tick += 1
@@ -762,6 +787,9 @@ class Bm25ColumnCache:
             return
         if len(missing) > self.capacity:
             raise ValueError(f"query batch needs {len(missing)} terms > capacity {self.capacity}")
+        self._hbm.note_protect_pressure(
+            len(batch_terms & set(self.term_slot)) + len(missing),
+            self.capacity)
         if len(missing) > len(self._free):
             self._free.extend(self._evict(len(missing) - len(self._free), batch_terms))
 
@@ -812,13 +840,14 @@ class Bm25ColumnCache:
         mT = next_bucket(max((len(q) for q in queries), default=1), minimum=4)
         qpacked = np.zeros((Q, 2, mT), np.float32)
         qpacked[:, 0, :] = self.capacity                 # pad slot
-        for qi, q in enumerate(queries):
-            for j, t in enumerate(q):
-                idf = self.term_idf.get(t, 0.0)
-                if idf == 0.0:
-                    continue
-                qpacked[qi, 0, j] = self.term_slot[t]
-                qpacked[qi, 1, j] = idf
+        with self._lock:   # slots must not be evicted while being packed
+            for qi, q in enumerate(queries):
+                for j, t in enumerate(q):
+                    idf = self.term_idf.get(t, 0.0)
+                    if idf == 0.0:
+                        continue
+                    qpacked[qi, 0, j] = self.term_slot[t]
+                    qpacked[qi, 1, j] = idf
         dp = self.mesh.shape.get("dp", 1)
         n_pad = -Q % dp
         if n_pad:

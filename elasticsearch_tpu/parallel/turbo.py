@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
-from elasticsearch_tpu.common import faults, tracing
+from elasticsearch_tpu.common import faults, hbm_ledger, tracing
 from elasticsearch_tpu.common.errors import DeviceFaultError
 from elasticsearch_tpu.common.faults import FaultRecord
 from elasticsearch_tpu.index.positions import phrase_freqs
@@ -291,6 +291,22 @@ class TurboBM25:
         self.stats = {"builds": 0, "build_s": 0.0, "fallbacks": 0,
                       "cold_queries": 0, "dispatches": 0, "degraded": 0,
                       "phrase_builds": 0, "bool_host": 0, "bool_device": 0}
+        # HBM residency ledger: regions mirror hbm_bytes() exactly so the
+        # telemetry cross-check can hold ledger == engine to the byte
+        self._hbm = hbm_ledger.register_engine(self, "turbo")
+        self._register_hbm_regions()
+
+    def _register_hbm_regions(self) -> None:
+        self._hbm.set_region("cols_hi", self.cols_hi.nbytes)
+        self._hbm.set_region("cols_lo", self.cols_lo.nbytes)
+        self._hbm.set_region("lane_docs", self.lane_docs.nbytes)
+        self._hbm.set_region("lane_scores", self.lane_scores.nbytes)
+        self._hbm.set_region("live", self.live.nbytes)
+
+    def hbm_bytes(self) -> int:
+        return (self.cols_hi.nbytes + self.cols_lo.nbytes
+                + self.lane_docs.nbytes + self.lane_scores.nbytes
+                + self.live.nbytes)
 
     # ---------------- term metadata ----------------
 
@@ -325,6 +341,7 @@ class TurboBM25:
             max(ROWS_PER_STEP, -(-int(s) // ROWS_PER_STEP) * ROWS_PER_STEP)
             for s in sizes)
         self.qc_sizes = tuple(sorted(merged))
+        hbm_ledger.note_primed("turbo", self.qc_sizes)
 
     # ---------------- column cache ----------------
 
@@ -359,6 +376,9 @@ class TurboBM25:
             z = np.zeros(len(bases), np.int32)
             self._pending_zero.append(
                 (z, z, bases, np.full(len(bases), slot, np.int32)))
+        # churn accounting: a slot is 2 bytes/padded-doc (hi + lo layers)
+        self._hbm.note_eviction(freed_bytes=2 * self.Dp)
+        self._hbm.note_zeroed_tiles(0 if bases is None else len(bases))
         if key.startswith("\x00p:"):
             # phrase metadata carries the (docs, pf) arrays — drop them
             # with the column, recompute if the phrase is colized again
@@ -374,12 +394,15 @@ class TurboBM25:
                                  jnp.int8)
         self.cols_lo = jnp.zeros((dp_chunks, self.Hp + 1, 16, 128),
                                  jnp.int8)
+        self._hbm.note_eviction(count=len(self._slot_of),
+                                freed_bytes=2 * self.Dp * len(self._slot_of))
         self._slot_of.clear()
         self._lru.clear()
         self._free = list(range(self.Hp))
         self._pending_zero = []
         self._tile_bases.clear()
         self.cols_epoch += 1
+        self._register_hbm_regions()
 
     def ensure_columns(self, terms: Sequence[str],
                        protect_extra: Sequence[str] = ()) -> None:
@@ -399,6 +422,11 @@ class TurboBM25:
         if not need:
             return
         protect = set(t for t, _ in need) | set(terms) | set(protect_extra)
+        # slots the eviction pass may NOT reclaim this batch (cached keys
+        # pinned by protect, plus the incoming builds) vs total capacity
+        self._hbm.note_protect_pressure(
+            sum(1 for t in self._slot_of if t in protect) + len(need),
+            self.Hp)
         deficit = len(need) - len(self._free)
         if deficit > 0:
             victims = [t for t in sorted(self._lru, key=self._lru.get)
@@ -460,6 +488,7 @@ class TurboBM25:
         self.cols_epoch += 1
         self.stats["builds"] += len(need)
         self.stats["build_s"] += time.monotonic() - t0
+        self._register_hbm_regions()
 
     # ---------------- phrase columns ----------------
 
@@ -733,9 +762,16 @@ class TurboBM25:
             chunk = flat[off: off + take]
             if check is not None:
                 check()
+            # compile-cache telemetry: the first dispatch at a new width
+            # IS the XLA trace, so its wall time is the compile cost
+            first_trace = hbm_ledger.note_dispatch("turbo", take)
+            tc0 = time.monotonic()
             wq, qscale, (rm, rr) = self._sweep(chunk, take)
             with faults.device_errors("turbo_sweep", self.part_id):
                 picked = _pick_rows(rm, rr, n_rows=n_rows)
+            if first_trace:
+                hbm_ledger.note_compile_done(
+                    "turbo", take, time.monotonic() - tc0)
             pending.append((off, len(chunk), picked))
             off += len(chunk)
         self.stats["dispatches"] += len(pending)
@@ -1528,6 +1564,16 @@ class ShardedTurbo:
         self._sharding = sh
         self._epochs = [-1] * S
         self.fused_dispatches = 0
+        # fused cache is a separate device allocation on top of the
+        # per-partition engines' own regions
+        self._hbm = hbm_ledger.register_engine(
+            self, "fused_turbo", devices=G)
+        self._register_hbm_regions()
+
+    def _register_hbm_regions(self) -> None:
+        self._hbm.set_region("cols_hi", self.cols_hi.nbytes)
+        self._hbm.set_region("cols_lo", self.cols_lo.nbytes)
+        self._hbm.set_region("live", self.live.nbytes)
 
     def extend_qc_sizes(self, sizes) -> None:
         """Bucket-ladder hook, fused flavor: keeps the fused chunker and
@@ -1536,6 +1582,7 @@ class ShardedTurbo:
         for t in self.turbos:
             t.extend_qc_sizes(sizes)
         self.qc_sizes = self.turbos[0].qc_sizes
+        hbm_ledger.note_primed("fused_turbo", self.qc_sizes)
 
     def _refresh_part(self, i: int) -> None:
         """Re-sync one partition's fused column slice if its cache was
@@ -1550,6 +1597,7 @@ class ShardedTurbo:
             self.cols_lo = jax.device_put(
                 self.cols_lo.at[i, :a, :b].set(t.cols_lo), self._sharding)
         self._epochs[i] = t.cols_epoch
+        self._register_hbm_regions()
 
     def _refresh(self) -> None:
         for i in range(len(self.turbos)):
@@ -1584,12 +1632,16 @@ class ShardedTurbo:
         # counted — the circuit tests pin "zero device dispatches" while
         # open by watching it
         t0 = time.monotonic()
+        first_trace = hbm_ledger.note_dispatch("fused_turbo", QC)
         with faults.device_dispatch("fused_dispatch"):
             out = _fused_sweep_disj(
                 jnp.asarray(qs), self.cols_hi, self.cols_lo,
                 jnp.asarray(wq), self.live, mesh=self.mesh, QC=QC,
                 nsw=self.nsw, n_rows=n_rows)
         self.fused_dispatches += 1
+        if first_trace:
+            hbm_ledger.note_compile_done(
+                "fused_turbo", QC, time.monotonic() - t0)
         self._trace_chunk(QC, t0)
         return out
 
@@ -1609,12 +1661,16 @@ class ShardedTurbo:
             nreq[i] = nr
             qs[i] = q
         t0 = time.monotonic()
+        first_trace = hbm_ledger.note_dispatch("fused_turbo_bool", QC)
         with faults.device_dispatch("fused_dispatch"):
             out = _fused_sweep_bool(
                 jnp.asarray(qs), jnp.asarray(nreq), self.cols_hi,
                 self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
                 mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
         self.fused_dispatches += 1
+        if first_trace:
+            hbm_ledger.note_compile_done(
+                "fused_turbo_bool", QC, time.monotonic() - t0)
         self._trace_chunk(QC, t0)
         return out
 

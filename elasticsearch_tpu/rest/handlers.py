@@ -170,6 +170,9 @@ def register_handlers(node: Node, rc: RestController) -> None:
     # search flight recorder (PR 9)
     r("GET", "/_tpu/slowlog", h.tpu_slowlog)
     r("GET", "/_tpu/trace", h.tpu_traces)
+    # device telemetry plane (PR 12)
+    r("GET", "/_tpu/metrics", h.tpu_metrics)
+    r("GET", "/_tpu/metrics/history", h.tpu_metrics_history)
     # lifecycle admin
     r("POST", "/{index}/_close", h.close_index)
     r("POST", "/{index}/_open", h.open_index)
@@ -248,6 +251,11 @@ def _ok(body, status=200) -> RestResponse:
 class _Handlers:
     def __init__(self, node: Node):
         self.node = node
+        # the telemetry plane answers stats RPCs with this node's full
+        # REST sections rather than the module-global default set
+        tp = getattr(node, "telemetry_plane", None)
+        if tp is not None:
+            tp.local_stats_fn = self._local_node_stats
 
     # ---------- info ----------
 
@@ -1032,10 +1040,19 @@ class _Handlers:
             tracing.record_trace(tc)
             if isinstance(rr.body, dict) and isinstance(
                     rr.body.get("profile"), dict):
-                rr.body["profile"].setdefault("tpu", {
+                from elasticsearch_tpu.common import hbm_ledger
+
+                # routing explainability (PR 12): why this index's engine
+                # selection went turbo or not, with the byte arithmetic
+                routing = hbm_ledger.last_routing()
+                tpu_profile = {
                     "trace_id": tc.trace_id, "opaque_id": tc.opaque_id,
                     "node": self.node.node_name,
-                    "phases": tc.phase_totals()})
+                    "phases": tc.phase_totals()}
+                if routing is not None:
+                    tpu_profile["routing_reason"] = routing["reason"]
+                    tpu_profile["routing"] = routing
+                rr.body["profile"].setdefault("tpu", tpu_profile)
         return rr
 
     def _search_inner(self, req: RestRequest) -> RestResponse:
@@ -1971,30 +1988,77 @@ class _Handlers:
             } for nid, n in cs.nodes.items()},
         })
 
+    def _local_node_stats(self) -> dict:
+        """This node's full stats sections — the REST body for a
+        single-node cluster and the telemetry plane's RPC answer when a
+        peer coordinator fans out (cluster/telemetry_plane.py)."""
+        return {
+            "name": self.node.node_name,
+            "indices": {"docs": {"count": sum(
+                self.node.indices.get(n).doc_count() for n in self.node.indices.names())}},
+            "breakers": self.node.breakers.stats(),
+            "indexing_pressure": self.node.indexing_pressure.stats(),
+            "thread_pool": self.node.thread_pool.stats(),
+            "tpu_coalescer": _default_coalescer_stats(),
+            "tpu_scheduler": _default_scheduler_stats(),
+            "tpu_turbo": _turbo_merge_stats(),
+            "tpu_health": _tpu_health_stats(),
+            "tpu_coordinator": _tpu_coordinator_stats(),
+            "tpu_durability": _tpu_durability_stats(),
+            "tpu_search_latency": _tpu_search_latency_stats(),
+            "tpu_settings": _tpu_settings_stats(),
+            "tpu_hbm": _tpu_hbm_stats(),
+            "tpu_compile": _tpu_compile_stats(),
+            "tpu_tasks": self.node.tasks.stats(),
+            "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
+        }
+
     def nodes_stats(self, req: RestRequest) -> RestResponse:
+        """GET /_nodes/stats — cluster fan-out through the telemetry
+        plane: a dead peer degrades to a `node_failures` entry and
+        partial stats, never a failed response (PR 11 /_tasks
+        semantics)."""
         cs = self.node.cluster_state
-        return _ok({
-            "_nodes": {"total": len(cs.nodes), "successful": len(cs.nodes), "failed": 0},
+        per_node, failures = self.node.telemetry_plane.nodes_stats()
+        nodes = {}
+        for name, stats in per_node.items():
+            # the local node keeps its id key (response-shape compat);
+            # peers key by the name the channels layer routes on
+            key = self.node.node_id if name == self.node.node_name else name
+            nodes[key] = stats
+        out = {
+            "_nodes": {"total": len(per_node) + len(failures),
+                       "successful": len(per_node),
+                       "failed": len(failures)},
             "cluster_name": cs.cluster_name,
-            "nodes": {self.node.node_id: {
-                "name": self.node.node_name,
-                "indices": {"docs": {"count": sum(
-                    self.node.indices.get(n).doc_count() for n in self.node.indices.names())}},
-                "breakers": self.node.breakers.stats(),
-                "indexing_pressure": self.node.indexing_pressure.stats(),
-                "thread_pool": self.node.thread_pool.stats(),
-                "tpu_coalescer": _default_coalescer_stats(),
-                "tpu_scheduler": _default_scheduler_stats(),
-                "tpu_turbo": _turbo_merge_stats(),
-                "tpu_health": _tpu_health_stats(),
-                "tpu_coordinator": _tpu_coordinator_stats(),
-                "tpu_durability": _tpu_durability_stats(),
-                "tpu_search_latency": _tpu_search_latency_stats(),
-                "tpu_settings": _tpu_settings_stats(),
-                "tpu_tasks": self.node.tasks.stats(),
-                "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
-            }},
-        })
+            "nodes": nodes,
+        }
+        if failures:
+            out["_nodes"]["failures"] = failures
+            out["node_failures"] = failures
+        return _ok(out)
+
+    def tpu_metrics(self, req: RestRequest) -> RestResponse:
+        """GET /_tpu/metrics — every declared counter/gauge/histogram from
+        all live nodes as one Prometheus text exposition (histograms in
+        cumulative-`le` form); dead peers degrade to es_tpu_node_up 0."""
+        text, _failures = self.node.telemetry_plane.prometheus()
+        return RestResponse(body=text,
+                            content_type="text/plain; version=0.0.4")
+
+    def tpu_metrics_history(self, req: RestRequest) -> RestResponse:
+        """GET /_tpu/metrics/history — the sampler ring: periodic
+        counter/gauge snapshots (ES_TPU_METRICS_SAMPLE_S) plus provider
+        sections like the scheduler's per-lane busy fraction, so rates
+        are computable without an external scraper."""
+        from elasticsearch_tpu.common import metrics as _m
+        from elasticsearch_tpu.common.settings import knob
+
+        samples = _m.metrics_history()
+        return _ok({"interval_s": knob("ES_TPU_METRICS_SAMPLE_S"),
+                    "capacity": knob("ES_TPU_METRICS_HISTORY"),
+                    "sampler_running": _m.maybe_start_sampler(),
+                    "samples": samples})
 
     def tpu_slowlog(self, req: RestRequest) -> RestResponse:
         """GET /_tpu/slowlog — the bounded in-memory search slowlog ring:
@@ -2388,6 +2452,26 @@ def _tpu_settings_stats() -> dict:
     from elasticsearch_tpu.common.settings import effective_knobs
 
     return effective_knobs()
+
+
+def _tpu_hbm_stats() -> dict:
+    """HBM residency section (PR 12): per-engine device-byte occupancy
+    (byte-identical to the engines' own hbm_bytes()), high watermark,
+    eviction/churn counters, protected-slot pressure, budget headroom vs
+    ES_TPU_TURBO_HBM, and the turbo_eligible routing log."""
+    from elasticsearch_tpu.common import hbm_ledger
+
+    return hbm_ledger.hbm_stats()
+
+
+def _tpu_compile_stats() -> dict:
+    """Compile-cache section (PR 12): primed dispatch shapes, per-dispatch
+    hit/miss counters, unplanned retraces, warmup coverage ratio, and the
+    recent first-trace events with wall cost — the cold-start cliff and
+    the scheduler bucket ladder, measured."""
+    from elasticsearch_tpu.common import hbm_ledger
+
+    return hbm_ledger.compile_stats()
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

@@ -43,7 +43,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from elasticsearch_tpu.common import faults, metrics, tracing
+from elasticsearch_tpu.common import faults, hbm_ledger, metrics, tracing
 from elasticsearch_tpu.common.errors import (
     DeviceFaultError, SearchPhaseExecutionError,
 )
@@ -710,6 +710,8 @@ def turbo_eligible(segments, field: str, mesh, *,
 
     force = knob("ES_TPU_FORCE_TURBO")
     if not force and jax.default_backend() != "tpu":
+        hbm_ledger.note_routing(field, False, "backend_not_tpu",
+                                0, hbm_budget_bytes)
         return False
     if cold_df is None:
         cold_df = _env_cold_df()
@@ -723,7 +725,16 @@ def turbo_eligible(segments, field: str, mesh, *,
         dp = -(-n_docs // SW) * SW
         n_col = int((fp.doc_freq >= cdf).sum())
         cache += 2 * dp * (((n_col + 8 + 31) // 32) * 32 + 1)
-    return cache <= hbm_budget_bytes
+    # explanation only — the decision formula above is the contract
+    eligible = cache <= hbm_budget_bytes
+    if not eligible:
+        reason = "exceeds_hbm_budget"
+    elif force and jax.default_backend() != "tpu":
+        reason = "forced_turbo"
+    else:
+        reason = "fits_hbm_budget"
+    hbm_ledger.note_routing(field, eligible, reason, cache, hbm_budget_bytes)
+    return eligible
 
 
 def select_bm25_engine(segments, field: str, live_masks, mesh, *,

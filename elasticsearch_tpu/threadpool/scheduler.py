@@ -232,6 +232,9 @@ class AdaptiveDispatchScheduler:
         self._bucket_counts: Dict[int, int] = {}        # guarded by: _lock
         self._tier_counts: Dict[str, int] = {}          # guarded by: _lock
         self._tier_wait_ms: Dict[str, float] = {}       # guarded by: _lock
+        # per-lane in-flight batches, the raw series behind the sampler's
+        # per-lane device busy fraction (PR 12)
+        self._lane_inflight: Dict[Tuple[int, int], int] = {}  # guarded by: _lock
 
     # ---- knob-or-constructor configuration ----
 
@@ -329,6 +332,13 @@ class AdaptiveDispatchScheduler:
                 lane.slots.release()
                 with self._lock:
                     self._inflight -= 1
+                    left = self._lane_inflight.get(lane.key, 0) - 1
+                    if left > 0:
+                        self._lane_inflight[lane.key] = left
+                    else:
+                        self._lane_inflight.pop(lane.key, None)
+                    inflight_now = self._inflight
+                metrics.gauge_set("sched_inflight", inflight_now)
 
     # ---- lane registry ----
 
@@ -472,8 +482,14 @@ class AdaptiveDispatchScheduler:
                 self._largest_batch = n
             self._bucket_counts[batch.bucket] = \
                 self._bucket_counts.get(batch.bucket, 0) + 1
+            self._lane_inflight[lane.key] = \
+                self._lane_inflight.get(lane.key, 0) + 1
+            inflight_now, lanes_now = self._inflight, len(self._lanes)
         metrics.observe("sched_bucket_size", batch.bucket)
         metrics.observe("sched_queue_depth", depth)
+        metrics.gauge_set("sched_inflight", inflight_now)
+        metrics.gauge_set("sched_lanes", lanes_now)
+        metrics.counter_add("sched_flushes")
         try:
             with tracing.activate(batch.trace):
                 t_dev = time.monotonic()
@@ -524,7 +540,23 @@ class AdaptiveDispatchScheduler:
                 "max_inflight": self._max_inflight,
                 "bucket_counts": {str(b): c for b, c in
                                   sorted(self._bucket_counts.items())},
+                "lane_inflight": {f"{e}/{k}": c for (e, k), c in
+                                  sorted(self._lane_inflight.items())},
                 "tiers": tiers,
+            }
+
+    def sample(self) -> dict:
+        """Sampler-ring section: per-lane slot occupancy at the sample
+        instant, so the history ring yields a device busy-fraction series
+        without an external scraper."""
+        slots = max(1, self._inflight_slots())
+        with self._lock:
+            return {
+                "inflight": self._inflight,
+                "lanes": len(self._lanes),
+                "lane_busy_fraction": {
+                    f"{e}/{k}": round(min(1.0, c / slots), 4)
+                    for (e, k), c in sorted(self._lane_inflight.items())},
             }
 
 
@@ -567,3 +599,9 @@ def scheduler_stats() -> dict:
     return {"mode": knob("ES_TPU_SCHED_MODE"),
             "mode_dispatches": modes,
             **default_scheduler().stats()}
+
+
+# every metrics-history sample carries the default scheduler's per-lane
+# occupancy snapshot (PR 12)
+metrics.register_sample_provider(
+    "tpu_scheduler", lambda: default_scheduler().sample())

@@ -437,6 +437,42 @@ def record(ms):
     assert lint_sources([orphan], select={"TPU005"}) == []
 
 
+# TPU005's gauge-surface pass (PR 12): a file that declares a gauge must
+# also surface it — the dotted tail has to appear as a key in some *stats()
+# function in the same file, otherwise the gauge scrapes over /_tpu/metrics
+# but is invisible in its owning `_nodes/stats` section.
+
+_TPU005_GAUGE_BAD = '''
+from elasticsearch_tpu.common import metrics
+
+metrics.declare_gauge("tpu_widget.occupancy_bytes", "bytes resident")
+
+def widget_stats():
+    return {"evictions": 0}
+'''
+
+
+def test_tpu005_unsurfaced_gauge_detected():
+    findings = lint_sources([(_TPU005_PATH, _TPU005_GAUGE_BAD)],
+                            select={"TPU005"})
+    assert rules_of(findings) == ["TPU005"]
+    assert "tpu_widget.occupancy_bytes" in findings[0].message
+
+
+def test_tpu005_surfaced_gauge_clean():
+    ok = _TPU005_GAUGE_BAD.replace(
+        'return {"evictions": 0}',
+        'return {"evictions": 0, "occupancy_bytes": 0}')
+    assert lint_sources([(_TPU005_PATH, ok)], select={"TPU005"}) == []
+
+
+def test_tpu005_gauge_pass_exempts_metrics_registry():
+    """common/metrics.py holds the central cross-subsystem declarations
+    (e.g. scheduler gauges) whose stats() surfaces live elsewhere."""
+    registry = ("elasticsearch_tpu/common/metrics.py", _TPU005_GAUGE_BAD)
+    assert lint_sources([registry], select={"TPU005"}) == []
+
+
 # --------------------------------------------------------------------------
 # Baseline machinery
 # --------------------------------------------------------------------------

@@ -1,0 +1,267 @@
+"""Device telemetry plane (PR 12): HBM residency ledger, compile-cache
+introspection, Prometheus exposition, and the nodes-stats fan-out.
+
+The load-bearing contracts:
+  * telemetry is pure observation — results are bit-identical with the
+    sampler armed vs disabled (ES_TPU_METRICS_SAMPLE_S=0);
+  * `tpu_hbm.occupancy_bytes` mirrors the engines' own `hbm_bytes()`
+    arithmetic EXACTLY, through eviction churn and rebuilds;
+  * /_tpu/metrics is one valid cluster-wide Prometheus document covering
+    every declared metric, with dead peers degrading to node_up 0 rows.
+"""
+
+import re
+import time
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.cluster_node import form_local_cluster
+from elasticsearch_tpu.common import hbm_ledger, metrics
+from elasticsearch_tpu.index.segment import build_field_postings
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+from elasticsearch_tpu.parallel.turbo import TurboBM25
+from elasticsearch_tpu.rest import RestController, register_handlers
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    metrics.reset_for_tests()
+    hbm_ledger.reset_for_tests()
+    yield
+    metrics.reset_for_tests()
+    hbm_ledger.reset_for_tests()
+
+
+class _Seg:
+    def __init__(self, n_docs, fp):
+        self.n_docs = n_docs
+        self.postings = {"body": fp}
+        self.vectors = {}
+
+
+def _corpus(n_docs=2000, vocab=60, seed=5):
+    rng = np.random.default_rng(seed)
+    probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+    probs /= probs.sum()
+    lens = rng.integers(4, 20, size=n_docs).astype(np.int64)
+    tokens = rng.choice(vocab, size=int(lens.sum()), p=probs).astype(np.int64)
+    names = [f"t{i}" for i in range(vocab)]
+    tok_docs = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+    fp = build_field_postings("body", lens, tok_docs, tokens, names)
+    return fp, rng
+
+
+def _turbo(seed=5, **kw):
+    fp, rng = _corpus(seed=seed)
+    stacked = build_stacked_bm25([_Seg(2000, fp)], "body", serve_only=True)
+    kw.setdefault("hbm_budget_bytes", 64 << 20)
+    kw.setdefault("cold_df", 10)
+    return TurboBM25(stacked, **kw), rng
+
+
+# ------------------------------------------------------------------ differential
+
+
+def test_telemetry_armed_is_bit_identical_to_disabled(monkeypatch):
+    """The sampler thread plus every ledger hook must not perturb a single
+    bit of the scoring path."""
+
+    def run(sample_s):
+        monkeypatch.setenv("ES_TPU_METRICS_SAMPLE_S", sample_s)
+        metrics.reset_for_tests()
+        hbm_ledger.reset_for_tests()
+        armed = metrics.maybe_start_sampler()
+        turbo, rng = _turbo(seed=11)
+        queries = [[f"t{a}", f"t{b}"] for a, b in
+                   rng.integers(0, 60, size=(16, 2))]
+        scores, ords = turbo.search(queries, k=10)
+        return armed, np.asarray(scores).tobytes(), np.asarray(ords).tobytes()
+
+    armed, s1, o1 = run("0.01")
+    assert armed is True
+    time.sleep(0.05)           # let the sampler take at least one snapshot
+    assert len(metrics.metrics_history()) >= 1
+    disarmed, s2, o2 = run("0")
+    assert disarmed is False
+    assert s1 == s2 and o1 == o2
+
+
+# ------------------------------------------------------------ ledger exactness
+
+
+def test_ledger_matches_hbm_bytes_exactly_under_churn():
+    turbo, _ = _turbo(seed=7, hbm_budget_bytes=1, cold_df=5)
+    assert turbo.Hp == 32
+    assert turbo._hbm.total_bytes() == turbo.hbm_bytes()
+    assert hbm_ledger.hbm_stats()["occupancy_bytes"] == turbo.hbm_bytes()
+    # fill past capacity in two waves so the second forcibly evicts
+    turbo.search([[f"t{i}"] for i in range(30)], k=5)
+    turbo.search([[f"t{i}"] for i in range(30, 60)], k=5)
+    st = hbm_ledger.hbm_stats()
+    assert st["evictions"] > 0
+    assert st["churn_bytes"] > 0
+    assert turbo._hbm.total_bytes() == turbo.hbm_bytes()
+    assert st["occupancy_bytes"] == turbo.hbm_bytes()
+    assert st["high_watermark_bytes"] >= st["occupancy_bytes"]
+    assert st["budget_bytes"] >= 0
+    (entry,) = st["engines"].values()
+    assert entry["kind"] == "turbo"
+    assert entry["occupancy_bytes"] == turbo.hbm_bytes()
+
+
+def test_ledger_drops_engine_on_gc():
+    turbo, _ = _turbo(seed=9)
+    occ = hbm_ledger.hbm_stats()["occupancy_bytes"]
+    assert occ == turbo.hbm_bytes() > 0
+    del turbo
+    import gc
+    gc.collect()
+    st = hbm_ledger.hbm_stats()
+    assert st["occupancy_bytes"] == 0
+    assert st["engines"] == {}
+
+
+# ------------------------------------------------------ compile introspection
+
+
+def test_compile_cache_introspection_hits_misses_priming():
+    turbo, rng = _turbo(seed=1)
+    queries = [[f"t{a}", f"t{b}"] for a, b in
+               rng.integers(0, 60, size=(12, 2))]
+    turbo.search(queries, k=10)
+    cs1 = hbm_ledger.compile_stats()
+    assert cs1["misses"] >= 1
+    assert cs1["events"], "first traces must record compile events"
+    ev = cs1["events"][0]
+    assert ev["engine"] == "turbo" and ev["wall_ms"] >= 0.0
+    # the same shapes again: pure cache hits, no new traces
+    turbo.search(queries, k=10)
+    cs2 = hbm_ledger.compile_stats()
+    assert cs2["misses"] == cs1["misses"]
+    assert cs2["hits"] > cs1["hits"]
+    assert 0.0 < cs2["warmup_coverage_ratio"] <= 1.0
+    # bucket priming surfaces in primed_shapes and flips retrace accounting
+    turbo.extend_qc_sizes((128,))
+    cs3 = hbm_ledger.compile_stats()
+    assert "turbo:128" in cs3["primed_shapes"]
+    assert cs3["retraces"] == cs2["retraces"]
+
+
+def test_turbo_eligible_records_routing_reason():
+    from elasticsearch_tpu.search.serving import turbo_eligible
+
+    fp, _ = _corpus(seed=3)
+    eligible = turbo_eligible([_Seg(2000, fp)], "body", None)
+    last = hbm_ledger.last_routing()
+    assert last is not None
+    assert last["index"] == "body"
+    assert last["eligible"] is eligible
+    # on the CPU test mesh the backend gate decides (unless forced)
+    assert last["reason"] in ("backend_not_tpu", "forced_turbo",
+                              "fits_hbm_budget", "exceeds_hbm_budget")
+    assert hbm_ledger.last_routing_reason() == last["reason"]
+
+
+# ------------------------------------------------------- Prometheus exposition
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?\d+(\.\d+)?([eE][+-]?\d+)?$')
+
+
+def test_prometheus_exposition_golden_format():
+    metrics.counter_add("sched_flushes")
+    metrics.gauge_set("sched_inflight", 3)
+    metrics.observe("device", 1.5)
+    metrics.observe("device", 250.0)
+    text = metrics.render_prometheus(
+        {"a": metrics.scrape_payload()}, [{"node_id": "b"}])
+    assert text.endswith("\n")
+    lines = text.splitlines()
+    for ln in lines:
+        if not ln.startswith("#"):
+            assert _PROM_LINE.match(ln), f"invalid exposition line: {ln!r}"
+    assert 'es_tpu_node_up{node="a"} 1' in lines
+    assert 'es_tpu_node_up{node="b"} 0' in lines
+    assert "# TYPE es_tpu_sched_flushes_total counter" in lines
+    assert 'es_tpu_sched_flushes_total{node="a"} 1' in lines
+    assert "# TYPE es_tpu_sched_inflight gauge" in lines
+    assert 'es_tpu_sched_inflight{node="a"} 3' in lines
+    # histogram: cumulative le buckets, +Inf == _count, sum of samples
+    assert "# TYPE es_tpu_device histogram" in lines
+    buckets = [ln for ln in lines
+               if ln.startswith('es_tpu_device_bucket{node="a"')]
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert buckets[-1].startswith('es_tpu_device_bucket{node="a",le="+Inf"}')
+    assert counts[-1] == 2
+    assert 'es_tpu_device_count{node="a"} 2' in lines
+    assert 'es_tpu_device_sum{node="a"} 251.5' in lines
+    # EVERY declared metric renders — the acceptance bar for the scrape
+    for name in metrics.DECLARED_COUNTERS:
+        assert f"# TYPE {metrics._prom_name(name)}_total counter" in lines
+    for name in metrics.DECLARED_GAUGES:
+        assert f"# TYPE {metrics._prom_name(name)} gauge" in lines
+    for name in metrics.DECLARED:
+        assert f"# TYPE {metrics._prom_name(name)} histogram" in lines
+
+
+# ------------------------------------------------------------------- fan-out
+
+
+def test_nodes_stats_fanout_degrades_over_dead_peer():
+    nodes, store, channels = form_local_cluster(["a", "b"])
+    a, b = nodes
+    per_node, failures = a.telemetry_plane.nodes_stats()
+    assert set(per_node) == {"a", "b"} and failures == []
+    for sec in per_node.values():
+        assert "tpu_hbm" in sec and "tpu_compile" in sec
+        assert "occupancy_bytes" in sec["tpu_hbm"]
+    channels.kill("b")
+    per_node, failures = a.telemetry_plane.nodes_stats()
+    assert set(per_node) == {"a"}
+    assert [f["node_id"] for f in failures] == ["b"]
+    assert failures[0]["type"] == "failed_node_exception"
+    assert failures[0]["caused_by"]["type"] == "node_not_connected_exception"
+    text, pfail = a.telemetry_plane.prometheus()
+    assert 'es_tpu_node_up{node="a"} 1' in text
+    assert 'es_tpu_node_up{node="b"} 0' in text
+    assert [f["node_id"] for f in pfail] == ["b"]
+    channels.revive("b")
+    per_node, failures = a.telemetry_plane.nodes_stats()
+    assert set(per_node) == {"a", "b"} and failures == []
+
+
+# ---------------------------------------------------------------- REST surface
+
+
+def test_rest_metrics_endpoints_and_nodes_stats_sections():
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+    try:
+        r = rc.dispatch("GET", "/_tpu/metrics", {}, None)
+        assert r.status == 200
+        assert r.content_type.startswith("text/plain")
+        assert "# TYPE es_tpu_node_up gauge" in r.body
+        assert "# TYPE es_tpu_sched_inflight gauge" in r.body
+        h = rc.dispatch("GET", "/_tpu/metrics/history", {}, None)
+        assert h.status == 200
+        assert h.body["sampler_running"] is False   # knob defaults to 0
+        assert isinstance(h.body["samples"], list)
+        st = rc.dispatch("GET", "/_nodes/stats", {}, None)
+        assert st.status == 200
+        assert st.body["_nodes"]["failed"] == 0
+        sec = st.body["nodes"][node.node_id]
+        assert sec["tpu_hbm"]["occupancy_bytes"] >= 0
+        assert "warmup_coverage_ratio" in sec["tpu_compile"]
+    finally:
+        node.close()
+
+
+def test_sample_now_includes_scheduler_provider():
+    s = metrics.sample_now()
+    assert "ts" in s and "counters" in s and "gauges" in s
+    assert "tpu_scheduler" in s
+    assert set(metrics.metrics_history()[-1]) == set(s)

@@ -173,6 +173,9 @@ class Project:
       ``declare_histogram`` in common/metrics.py, so TPU005 can flag
       ``observe("...")`` sites whose name the registry (and therefore
       the ``tpu_search_latency`` stats surface) doesn't know.
+    * ``gauge_names``: telemetry gauges declared via ``declare_gauge``
+      in common/metrics.py or common/hbm_ledger.py (the two registry
+      modules), consulted by TPU005's gauge-surface pass.
     """
 
     def __init__(self, files: Sequence[FileContext]):
@@ -181,6 +184,7 @@ class Project:
         self.jitted: Dict[str, Set[str]] = {}
         self.knob_names: Set[str] = set()
         self.histogram_names: Set[str] = set()
+        self.gauge_names: Set[str] = set()
         for f in self.files:
             mod = self._module_name(f.path)
             self.jitted[mod] = self._collect_jitted(f.tree)
@@ -188,6 +192,9 @@ class Project:
                 self.knob_names |= self._collect_knobs(f.tree)
             if f.path.endswith("common/metrics.py"):
                 self.histogram_names |= self._collect_histograms(f.tree)
+            if f.path.endswith("common/metrics.py") \
+                    or f.path.endswith("common/hbm_ledger.py"):
+                self.gauge_names |= self._collect_gauges(f.tree)
 
     @staticmethod
     def _module_name(path: str) -> str:
@@ -228,6 +235,18 @@ class Project:
         for node in ast.walk(tree):
             if isinstance(node, ast.Call) \
                     and dotted_tail(node.func) == "declare_histogram" \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                names.add(node.args[0].value)
+        return names
+
+    @staticmethod
+    def _collect_gauges(tree: ast.Module) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and dotted_tail(node.func) == "declare_gauge" \
                     and node.args \
                     and isinstance(node.args[0], ast.Constant) \
                     and isinstance(node.args[0].value, str):

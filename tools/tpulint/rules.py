@@ -469,12 +469,22 @@ class CounterHygieneRule:
     ``search_latency_stats()``, so an undeclared name is a metric that can
     never reach `_nodes/stats` (and raises UndeclaredHistogramError the
     first time the line runs). Dynamically composed names go through
-    ``observe_if_declared`` which this rule deliberately ignores."""
+    ``observe_if_declared`` which this rule deliberately ignores.
+
+    And for telemetry gauges (PR 12): a module that calls
+    ``declare_gauge("section.tail", …)`` outside the central registry
+    (common/metrics.py, whose declarations surface via the Prometheus
+    renderer itself) owns that gauge, so the gauge's dotted tail must
+    appear as a string in some ``*stats()`` function in the SAME file —
+    otherwise the gauge scrapes but never shows in the owning module's
+    `_nodes/stats` section."""
 
     name = "TPU005"
     summary = ("counters a stats()-bearing class increments (`self.x += …`) "
                "must appear in its stats() surface; literal observe(...) "
-               "sites must name a histogram declared in common/metrics.py")
+               "sites must name a histogram declared in common/metrics.py; "
+               "declare_gauge names outside the registry must surface in a "
+               "*stats() function in the declaring file")
 
     @staticmethod
     def _self_attr(expr: ast.AST) -> Optional[str]:
@@ -506,6 +516,39 @@ class CounterHygieneRule:
                         f"that is not declared in common/metrics.py — it "
                         f"never surfaces in `tpu_search_latency` and raises "
                         f"UndeclaredHistogramError at runtime")
+                    if f:
+                        out.append(f)
+        # gauge-surface hygiene (PR 12): declare_gauge call sites outside
+        # the central registry must surface the gauge's dotted tail in a
+        # *stats() function in the same file
+        if not ctx.path.endswith("common/metrics.py"):
+            declared_here = [
+                node for node in ast.walk(ctx.tree)
+                if isinstance(node, ast.Call)
+                and dotted_tail(node.func) == "declare_gauge"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)]
+            if declared_here:
+                surfaced: List[str] = []
+                for fn in ast.walk(ctx.tree):
+                    if isinstance(fn, ast.FunctionDef) \
+                            and fn.name.endswith("stats"):
+                        for node in ast.walk(fn):
+                            if isinstance(node, ast.Constant) \
+                                    and isinstance(node.value, str):
+                                surfaced.append(node.value)
+                for node in declared_here:
+                    gname = node.args[0].value
+                    tail = gname.rsplit(".", 1)[-1]
+                    if any(tail in s for s in surfaced):
+                        continue
+                    f = ctx.finding(
+                        self.name, node,
+                        f"declare_gauge({gname!r}) has no matching key in "
+                        f"any *stats() function in this file — the gauge "
+                        f"scrapes but never surfaces in the owning "
+                        f"`_nodes/stats` section")
                     if f:
                         out.append(f)
         for cls in [n for n in ast.walk(ctx.tree)
