@@ -1458,6 +1458,98 @@ def dryrun_metrics() -> int:
     return 0 if ok else 1
 
 
+def dryrun_overload() -> int:
+    """Overload-control smoke (PR 13): single-node REST storm under an
+    injected YELLOW brownout — every bulk is shed as a clean 429 with a
+    Retry-After header, every interactive search is admitted with hits
+    bit-identical to the unloaded baseline and bounded latency, one RED
+    burst sheds an interactive request too, and every shed shows up in the
+    `tpu_overload` node-stats section. One JSON line on stdout; exit 0/1."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["ES_TPU_OVERLOAD_HYSTERESIS_MS"] = "0"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.common import faults, metrics, overload
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import RestController, register_handlers
+
+    metrics.reset_for_tests()
+    overload.reset_default_for_tests()
+    log("dryrun_overload: starting single-node REST brownout storm...")
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+
+    def call(method, path, body=None, params=None):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body)
+        return rc.dispatch(method, path, params or {}, body)
+
+    rounds = 10
+    try:
+        call("PUT", "/load", {"mappings": {
+            "properties": {"n": {"type": "integer"},
+                           "body": {"type": "text"}}}})
+        for i in range(32):
+            call("PUT", f"/load/_doc/{i}",
+                 {"n": i, "body": f"word{i % 5} common text"})
+        call("POST", "/load/_refresh")
+        q = {"query": {"match": {"body": "common"}}, "size": 10}
+        baseline = call("POST", "/load/_search", q)
+        bulk = "\n".join([
+            json.dumps({"index": {"_index": "load", "_id": "shed"}}),
+            json.dumps({"n": 999, "body": "must not land"}),
+        ]) + "\n"
+        bulk_shed = 0
+        retry_after_ok = True
+        identical = True
+        lat_ms = []
+        with faults.inject("overload_pressure:hang@1xinf"):
+            for _ in range(rounds):
+                r = call("POST", "/_bulk", bulk)
+                if r.status == 429:
+                    bulk_shed += 1
+                    ra = r.headers.get("Retry-After")
+                    retry_after_ok &= ra is not None and int(ra) >= 1
+                t0 = time.monotonic()
+                r = call("POST", "/load/_search", q)
+                lat_ms.append((time.monotonic() - t0) * 1e3)
+                identical &= (r.status == 200
+                              and r.body["hits"] == baseline.body["hits"])
+        with faults.inject("overload_pressure:raise@1x1"):
+            red = call("POST", "/load/_search", q)
+        call("POST", "/load/_refresh")
+        count = call("GET", "/load/_count").body["count"]
+        stats = call("GET", "/_nodes/stats").body
+        sec = next(iter(stats["nodes"].values()))["tpu_overload"]
+    finally:
+        node.close()
+        faults.clear()
+    p95 = sorted(lat_ms)[max(0, int(len(lat_ms) * 0.95) - 1)]
+    ok = (baseline.status == 200
+          and bulk_shed == rounds and retry_after_ok and identical
+          and red.status == 429
+          and count == 32                      # no shed bulk ever landed
+          and sec["shed"]["bulk"] == rounds
+          and sec["shed"]["interactive"] == 1
+          and p95 < 5000.0)                    # admitted p95 stays bounded
+    print(json.dumps({
+        "metric": "dryrun_overload",
+        "ok": bool(ok),
+        "rounds": rounds,
+        "bulk_shed": bulk_shed,
+        "interactive_shed": int(sec["shed"]["interactive"]),
+        "retry_after_ok": bool(retry_after_ok),
+        "identical": bool(identical),
+        "doc_count": int(count),
+        "admitted_p95_ms": round(p95, 3),
+    }), flush=True)
+    log(f"dryrun_overload: bulk_shed={bulk_shed}/{rounds} "
+        f"identical={identical} p95={p95:.1f}ms")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -1483,4 +1575,7 @@ if __name__ == "__main__":
     if "dryrun_metrics" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_metrics":
         sys.exit(dryrun_metrics())
+    if "dryrun_overload" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_overload":
+        sys.exit(dryrun_overload())
     main()

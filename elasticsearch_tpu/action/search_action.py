@@ -77,6 +77,7 @@ _COORD_COUNTERS: Dict[str, int] = {  # guarded by: _COORD_LOCK
     "fetch_failures": 0,       # shards dropped in the fetch phase
     "can_match_reroutes": 0,   # pre-filter targets demoted as unreachable
     "deadline_expired": 0,     # shards not attempted: request deadline hit
+    "overload_reroutes": 0,    # rankings where a pressured copy was demoted
 }
 
 
@@ -268,7 +269,7 @@ class SearchActionService:
 
     def __init__(self, transport: TransportService, channels: NodeChannels,
                  shard_service: DistributedShardService, breakers=None,
-                 thread_pool=None, tasks=None):
+                 thread_pool=None, tasks=None, overload=None):
         from elasticsearch_tpu.common.breaker import (
             HierarchyCircuitBreakerService,
         )
@@ -306,6 +307,19 @@ class SearchActionService:
         # transport failures quarantine the node from replica routing until
         # a half-open probe readmits it
         self._node_health: Dict[str, "NodeTransportHealth"] = {}
+        # overload controller (common/overload.py): transport admission on
+        # the data-node side, retry budget + piggybacked peer pressure on
+        # the coordinator side
+        self.overload = overload
+        # node -> (level, monotonic ts) from `_overload` piggybacks
+        self._node_pressure: Dict[str, tuple] = {}
+
+    def _overload(self):
+        if self.overload is None:
+            from elasticsearch_tpu.common.overload import default_overload
+
+            self.overload = default_overload()
+        return self.overload
 
     # ---------------- shard-level handlers (data node) ----------------
 
@@ -345,6 +359,7 @@ class SearchActionService:
 
     def _on_shard_query(self, req) -> dict:
         p = req.payload
+        self._admit_shard_request(p, f"[{p['index']}][{p['shard_id']}]")
         tc = tracing.child_from_wire(p.get("_trace"),
                                      node=self.shards.node_name,
                                      kind="shard_query")
@@ -370,7 +385,33 @@ class SearchActionService:
             out["_trace_spans"] = tc.span_dicts()
         self._shard_slowlog("query", p["index"], p["shard_id"], q_ms,
                             p["body"], tc)
+        ov = self.overload
+        if ov is not None:
+            # pressure propagation: piggyback this data node's level on
+            # the response payload (popped by the coordinator, never
+            # surfaced in a body) so ARS can route around brownout
+            out["_overload"] = ov.stats()["level"]
         return out
+
+    def _admit_shard_request(self, p: dict, where: str) -> None:
+        """Transport-side admission (data node): the coordinator's `_sla`
+        tier rides the payload; bulk-tier shard work sheds at YELLOW,
+        interactive at RED. A shed raises 429 back through the RPC — the
+        coordinator fails over to a less-loaded copy."""
+        ov = self.overload
+        if ov is None:
+            return
+        tier = p.get("_sla") or scheduler.TIER_INTERACTIVE
+        retry_after = ov.admit(tier)
+        if retry_after is None:
+            return
+        from elasticsearch_tpu.threadpool import EsRejectedExecutionError
+
+        raise EsRejectedExecutionError(
+            f"[{self.shards.node_name}] overload shed "
+            f"({ov.stats()['level']}): {tier}-tier shard request {where}",
+            node=self.shards.node_name, tier=tier,
+            retry_after_s=retry_after)
 
     def _shard_query_inner(self, req) -> dict:
         p = req.payload
@@ -568,21 +609,46 @@ class SearchActionService:
             if other != node:
                 self._node_ewma_ms[other] *= 0.98
 
+    def _note_node_pressure(self, node: str, level: str) -> None:
+        """Piggybacked data-node pressure (`_overload` on the shard-query
+        response payload): remembered with a timestamp so ARS ranking can
+        demote browned-out copies until the signal goes stale."""
+        self._node_pressure[node] = (level, time.monotonic())
+
+    def _pressure_rank(self, node: str) -> int:
+        """0 green/unknown/stale, 1 yellow, 2 red. Signals age out after
+        twice the hysteresis window (min 1s) — a node that stops answering
+        stops telling us it is overloaded, and must not be shunned forever."""
+        ent = self._node_pressure.get(node)
+        if ent is None:
+            return 0
+        level, ts = ent
+        ttl_s = max(1.0, 2 * int(knob("ES_TPU_OVERLOAD_HYSTERESIS_MS"))
+                    / 1000.0)
+        if time.monotonic() - ts > ttl_s:
+            return 0
+        return {"yellow": 1, "red": 2}.get(level, 0)
+
     def _rank_copies(self, copies) -> List[str]:
         """Replica-selection order for one shard's STARTED copies: the
         local copy is free; remote copies rank by service-time EWMA (ref:
-        OperationRouting.java:34); quarantined nodes (open transport
-        circuit) sink to last resort."""
+        OperationRouting.java:34); copies on nodes that piggybacked an
+        elevated overload level are demoted below green ones; quarantined
+        nodes (open transport circuit) sink to last resort."""
         from elasticsearch_tpu.common.health import CLOSED
 
         def key(r):
             h = self._node_health.get(r.node_id)
             quarantined = 1 if h is not None and h.state != CLOSED else 0
             local = 0 if r.node_id == self.shards.node_name else 1
-            return (quarantined, local,
+            return (quarantined, self._pressure_rank(r.node_id), local,
                     self._node_ewma_ms.get(r.node_id, 0.0), r.node_id)
 
-        return [r.node_id for r in sorted(copies, key=key)]
+        ranked = sorted(copies, key=key)
+        if len(ranked) > 1 and any(
+                self._pressure_rank(r.node_id) for r in ranked):
+            _count_coord("overload_reroutes")
+        return [r.node_id for r in ranked]
 
     @staticmethod
     def _failure_entry(index: str, sid: int, node: Optional[str],
@@ -711,6 +777,10 @@ class SearchActionService:
             self._record_transport_outcome(node)
             rpc_ms = (time.monotonic() - t_q) * 1000.0
             self._note_node_ok(node, rpc_ms)
+            self._overload().note_success()
+            lvl = resp.pop("_overload", None)
+            if lvl:
+                self._note_node_pressure(node, lvl)
             if tc is not None:
                 tc.add_span("rpc_query", rpc_ms, node=node,
                             index=target.index, shard=target.sid,
@@ -724,6 +794,11 @@ class SearchActionService:
             if len(attempted) >= budget:
                 break
             if deadline is not None and deadline.expired:
+                break
+            if attempted and not self._overload().retry_allowed(
+                    "shard_failover"):
+                # node-wide retry budget exhausted: fail fast with the
+                # organic error instead of amplifying a brownout
                 break
             h = self._node_health.get(node)
             if h is not None and not h.allow_request():

@@ -97,15 +97,27 @@ class ClusterNode:
             node_name, channels=channels,
             state_fn=lambda: self.state, transport=self.transport)
         _metrics.maybe_start_sampler()
+        from elasticsearch_tpu.common.overload import OverloadController
+        from elasticsearch_tpu.threadpool import default_scheduler
+
+        # overload control plane: one controller per node folds the
+        # pressure signals; the shard/search services consult it for
+        # transport admission and retry budgets
+        self.overload = OverloadController(
+            node_name, thread_pool=self.thread_pool,
+            scheduler=default_scheduler(),
+            indexing_pressure=self.indexing_pressure)
         self.shard_service = DistributedShardService(
             node_name, self.transport, channels, self.master_client,
             data_path, indexing_pressure=self.indexing_pressure,
-            thread_pool=self.thread_pool, tasks=self.tasks)
+            thread_pool=self.thread_pool, tasks=self.tasks,
+            overload=self.overload)
         self.applier = IndicesClusterStateService(
             node_name, self.shard_service, self.master_client)
         self.search_action = SearchActionService(
             self.transport, channels, self.shard_service,
-            thread_pool=self.thread_pool, tasks=self.tasks)
+            thread_pool=self.thread_pool, tasks=self.tasks,
+            overload=self.overload)
         t = self.transport
         t.register_request_handler("indices:admin/create",
                                    self._on_create_index)
@@ -466,6 +478,11 @@ class ClusterNode:
                         f"bulk deadline ({timeout_ms}ms) exceeded; "
                         f"last error: {last_err}")
                     break
+                if attempt and not self.overload.retry_allowed("bulk"):
+                    # node-wide retry budget exhausted: fail the items
+                    # with the organic error instead of hammering a
+                    # browned-out primary for the full retry count
+                    break
                 state = self.state
                 primary = state.primary_of(index, sid)
                 if primary is None or primary.node_id is None \
@@ -501,6 +518,7 @@ class ClusterNode:
                         bulk_payload)
                     self.search_action._record_transport_outcome(
                         primary.node_id)
+                    self.overload.note_success()
                     break
                 except (NodeUnavailableError, ShardNotFoundError,
                         PrimaryTermMismatchError, TranslogFsyncError) as e:

@@ -41,9 +41,11 @@ class CircuitBreaker:
                 self._trip_count += 1
                 raise CircuitBreakingError(
                     f"[{self.name}] Data too large, data for [{label}] would be "
-                    f"[{new_used}/{new_used}b], which is larger than the limit of "
-                    f"[{self.limit_bytes}/{self.limit_bytes}b]",
-                    bytes_wanted=new_used,
+                    f"[{new_used}b], wanted [{bytes_}b] on top of [{self._used}b] "
+                    f"already used, which is larger than the limit of "
+                    f"[{self.limit_bytes}b]",
+                    bytes_wanted=bytes_,
+                    bytes_used=self._used,
                     bytes_limit=self.limit_bytes,
                     durability="TRANSIENT",
                 )

@@ -15,8 +15,10 @@ Spec grammar (';'-separated clauses)::
              fused_dispatch, merge_kernel, column_upload, blockmax_pass),
              transport RPC sites — query path (rpc_query, rpc_fetch,
              rpc_can_match) and write path (rpc_bulk, rpc_replica_bulk,
-             rpc_recovery, rpc_resync) — or durability sites
-             (translog_fsync, translog_corrupt, segment_commit)
+             rpc_recovery, rpc_resync) — durability sites
+             (translog_fsync, translog_corrupt, segment_commit), or the
+             pressure site overload_pressure (modes pin a level instead of
+             raising: hang -> YELLOW, raise/oom -> RED)
       #part  restrict to one partition id — or, for transport sites, to one
              TARGET NODE by name (``rpc_query#d1``); default: any
       mode   raise | oom | hang
@@ -64,13 +66,19 @@ DURABILITY_SITES = frozenset({
     "segment_commit",    # segment + commit-point persistence in flush()
 })
 
+# Pressure-injection site (common/overload.py): deterministic brownout for
+# tests. Modes map to levels, not errors: hang -> YELLOW, raise/oom -> RED.
+OVERLOAD_SITES = frozenset({
+    "overload_pressure",  # OverloadController.evaluate() injection hook
+})
+
 KNOWN_SITES = frozenset({
     "turbo_sweep",       # TurboBM25 device sweep (disjunctive + bool)
     "fused_dispatch",    # ShardedTurbo fused S>1 shard_map dispatch
     "merge_kernel",      # device-side partition top-k merge
     "column_upload",     # int8 column build/refresh onto the device
     "blockmax_pass",     # BlockMax engine device pass
-}) | TRANSPORT_SITES | DURABILITY_SITES
+}) | TRANSPORT_SITES | DURABILITY_SITES | OVERLOAD_SITES
 
 _MODES = frozenset({"raise", "oom", "hang"})
 
@@ -247,6 +255,20 @@ def _fire_mode(site: str, part: Optional[Any]) -> Optional[tuple]:
                 continue
             return c.mode, c.arg
     return None
+
+
+def injected_overload_level() -> Optional[str]:
+    """Deterministic pressure injection for the overload controller.
+
+    Fires the ``overload_pressure`` site like any other clause (consuming
+    one call against @nth/xcount), but maps the mode to a pressure level
+    instead of raising: ``hang`` -> ``"yellow"``, ``raise``/``oom`` ->
+    ``"red"``. Returns None when no clause fires."""
+    hit = _fire_mode("overload_pressure", None)
+    if hit is None:
+        return None
+    mode, _arg = hit
+    return "yellow" if mode == "hang" else "red"
 
 
 def fault_point(site: str, part: Optional[int] = None) -> None:

@@ -416,6 +416,25 @@ declare_knob("ES_TPU_METRICS_SAMPLE_S", "float", 0.0,
 declare_knob("ES_TPU_METRICS_HISTORY", "int", 120,
              "Capacity of the in-memory metrics-sample ring (oldest "
              "samples drop first)")
+# overload control plane (PR 13)
+declare_knob("ES_TPU_OVERLOAD_YELLOW", "float", 0.7,
+             "Folded pressure score at which the node enters YELLOW "
+             "(bulk-tier requests shed with 429 + Retry-After)")
+declare_knob("ES_TPU_OVERLOAD_RED", "float", 0.9,
+             "Folded pressure score at which the node enters RED "
+             "(interactive requests shed too)")
+declare_knob("ES_TPU_OVERLOAD_HYSTERESIS_MS", "int", 2000,
+             "Pressure-level downgrade dwell: the raw level must stay "
+             "below the current one this long before the node steps down "
+             "(upgrades apply immediately)")
+declare_knob("ES_TPU_RETRY_BUDGET_RATIO", "float", 0.2,
+             "Retry tokens refilled per successful request into the "
+             "node-wide retry budget (0 disables the budget: retries are "
+             "unbounded as before)")
+declare_knob("ES_TPU_RETRY_BUDGET_CAP", "int", 32,
+             "Retry-budget bucket capacity (and initial fill): each "
+             "failover / replication / bulk / recovery / poison-solo "
+             "retry spends one token")
 
 
 class ClusterSettings:

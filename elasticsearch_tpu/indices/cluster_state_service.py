@@ -131,6 +131,12 @@ class IndicesClusterStateService:
             last_err: Optional[Exception] = None
             for attempt in range(attempts):
                 if attempt:
+                    ov = getattr(self.shards, "overload", None)
+                    if ov is not None and not ov.retry_allowed("recovery"):
+                        # node-wide retry budget exhausted: report the
+                        # organic error to the master now instead of
+                        # piling recovery retries onto a browned-out peer
+                        break
                     count("recoveries_retried")
                     time.sleep(backoff * (2 ** (attempt - 1)))
                 try:

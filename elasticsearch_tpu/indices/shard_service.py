@@ -104,7 +104,8 @@ class DistributedShardService:
                  channels: NodeChannels,
                  master_client: Callable[[str, dict], dict],
                  data_path: Optional[str] = None,
-                 indexing_pressure=None, thread_pool=None, tasks=None):
+                 indexing_pressure=None, thread_pool=None, tasks=None,
+                 overload=None):
         self.node_name = node_name
         self.transport = transport
         self.channels = channels
@@ -113,6 +114,9 @@ class DistributedShardService:
         # node TaskManager: primary-bulk handlers register child tasks
         # under the coordinator's `_parent_task` payload field when wired
         self.tasks = tasks
+        # overload controller (common/overload.py): bulk-tier admission at
+        # the primary-bulk handler + the replication retry budget
+        self.overload = overload
         self.shards: Dict[Tuple[str, int], ShardInstance] = {}
         self.state: ClusterState = ClusterState()
         self._registry_lock = threading.Lock()
@@ -210,10 +214,36 @@ class DistributedShardService:
 
     # ---------------- write path (primary side) ----------------
 
+    def _overload_ctl(self):
+        if self.overload is None:
+            from elasticsearch_tpu.common.overload import default_overload
+
+            self.overload = default_overload()
+        return self.overload
+
     def _on_primary_bulk(self, req) -> dict:
         from elasticsearch_tpu.tasks import task_manager as _taskmgr
 
         p = req.payload
+        # bulk-tier admission BEFORE any op is applied: a YELLOW node
+        # sheds the whole shard-bulk with 429 + Retry-After; nothing was
+        # written, nothing acked, so the coordinator can fail the items
+        # cleanly (replica/recovery paths are never shed — they finish
+        # work the primary already admitted)
+        ov = self.overload
+        if ov is not None:
+            retry_after = ov.admit("bulk")
+            if retry_after is not None:
+                from elasticsearch_tpu.threadpool import (
+                    EsRejectedExecutionError,
+                )
+
+                raise EsRejectedExecutionError(
+                    f"[{self.node_name}] overload shed "
+                    f"({ov.stats()['level']}): bulk-tier shard write "
+                    f"[{p['index']}][{p['shard_id']}]",
+                    node=self.node_name, tier="bulk",
+                    retry_after_s=retry_after)
         child = None
         if self.tasks is not None and p.get("_parent_task"):
             # child write task linked by the coordinator's `_parent_task`
@@ -336,12 +366,18 @@ class DistributedShardService:
         not cost an in-sync copy; anything that fails twice — or fails
         inside the replica (an application error) — escalates."""
         try:
-            return self.channels.request(
+            resp = self.channels.request(
                 node_id, "indices:data/write/bulk[s][r]", payload)
         except (NodeUnavailableError, RpcTimeoutError):
+            if not self._overload_ctl().retry_allowed("replication"):
+                # retry budget exhausted: escalate the organic transport
+                # error instead of doubling the replication storm
+                raise
             _count("replication_retries")
-            return self.channels.request(
+            resp = self.channels.request(
                 node_id, "indices:data/write/bulk[s][r]", payload)
+        self._overload_ctl().note_success()
+        return resp
 
     def _report_shard_failed(self, index: str, shard_id: int,
                              allocation_id: str, reason: str) -> None:

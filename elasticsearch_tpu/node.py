@@ -47,6 +47,15 @@ class Node:
         # node-level singleton) — the HTTP frontend and any attached
         # services draw their stage workers from the same bounded pools
         self.thread_pool = ThreadPool()
+        from elasticsearch_tpu.common.overload import OverloadController
+        from elasticsearch_tpu.threadpool import default_scheduler
+
+        # overload control plane (common/overload.py): folds this node's
+        # pressure signals for REST admission + retry budgets
+        self.overload = OverloadController(
+            node_name, thread_pool=self.thread_pool,
+            scheduler=default_scheduler(), breakers=self.breakers,
+            indexing_pressure=self.indexing_pressure)
         from elasticsearch_tpu.security import SecurityService
 
         self.security = SecurityService(self.settings)

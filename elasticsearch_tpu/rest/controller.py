@@ -45,6 +45,7 @@ class RestResponse:
     status: int = 200
     body: Any = None
     content_type: str = "application/json"
+    headers: Dict[str, str] = field(default_factory=dict)
 
     def encode(self) -> bytes:
         if isinstance(self.body, (bytes,)):
@@ -104,6 +105,10 @@ class RestController:
         # every handler when security is enabled (ref: the reference's
         # SecurityActionFilter wrapping the action chain)
         self.security_filter = None
+        # overload admission hook (common/overload.py) — called with
+        # (method, path, params) before body parse; a non-None RestResponse
+        # sheds the request (429 + Retry-After) without running the handler
+        self.admission = None
 
     def register(self, method: str, pattern: str, handler: Handler) -> None:
         self._routes.setdefault(method.upper(), []).append(_Route(pattern, handler))
@@ -119,6 +124,10 @@ class RestController:
             if matched is not None:
                 req_params = dict(params or {})
                 req_params.update(matched)
+                if self.admission is not None:
+                    shed = self.admission(method.upper(), path, req_params)
+                    if shed is not None:
+                        return shed
                 parsed, raw, parse_error = _parse_body(body)
                 if parse_error and not _is_ndjson_endpoint(parts):
                     err = JsonParseError("request body is not valid JSON")
@@ -132,7 +141,8 @@ class RestController:
                         self.security_filter(req, parts)
                     return route.handler(req)
                 except ElasticsearchTpuError as e:
-                    return RestResponse(status=e.status, body=_error_body(e))
+                    return RestResponse(status=e.status, body=_error_body(e),
+                                        headers=_backoff_headers(e))
                 except Exception as e:  # noqa: BLE001 — REST boundary
                     err = ElasticsearchTpuError(str(e))
                     return RestResponse(status=500, body=_error_body(err))
@@ -164,3 +174,12 @@ def _parse_body(body) -> Tuple[Any, bytes, bool]:
 def _error_body(e: ElasticsearchTpuError) -> dict:
     cause = e.to_dict()
     return {"error": {"root_cause": [cause], **cause}, "status": e.status}
+
+
+def _backoff_headers(e: ElasticsearchTpuError) -> Dict[str, str]:
+    """429s carry a Retry-After derived from the rejecting layer's hint
+    (pool queue EWMA or the overload controller's backoff)."""
+    ra = e.metadata.get("retry_after_s")
+    if ra is None:
+        return {}
+    return {"Retry-After": str(max(1, int(ra)))}

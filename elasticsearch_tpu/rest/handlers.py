@@ -25,7 +25,9 @@ from elasticsearch_tpu.common.errors import (
     VersionConflictError,
 )
 from elasticsearch_tpu.node import Node
-from elasticsearch_tpu.rest.controller import RestController, RestRequest, RestResponse
+from elasticsearch_tpu.rest.controller import (
+    RestController, RestRequest, RestResponse, _error_body,
+)
 from elasticsearch_tpu.search.queries import parse_query
 
 _START_TIME = time.time()
@@ -41,6 +43,13 @@ def register_handlers(node: Node, rc: RestController) -> None:
     sec = getattr(node, "security", None)
     if sec is not None and sec.enabled:
         rc.security_filter = sec.rest_filter
+
+    # overload admission (common/overload.py): shed data-path requests at
+    # the front door before any body parse or handler work — bulk tier at
+    # YELLOW, interactive too at RED. Management/snapshot requests are
+    # always admitted so stats and health stay reachable mid-brownout.
+    if getattr(node, "overload", None) is not None:
+        rc.admission = _overload_admission(node)
 
     r("GET", "/", h.root)
     # security management
@@ -2010,6 +2019,7 @@ class _Handlers:
             "tpu_hbm": _tpu_hbm_stats(),
             "tpu_compile": _tpu_compile_stats(),
             "tpu_tasks": self.node.tasks.stats(),
+            "tpu_overload": self.node.overload.stats(),
             "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
         }
 
@@ -2462,6 +2472,32 @@ def _tpu_hbm_stats() -> dict:
     from elasticsearch_tpu.common import hbm_ledger
 
     return hbm_ledger.hbm_stats()
+
+
+def _overload_admission(node):
+    """REST front-door admission check for `RestController.admission`:
+    returns a 429 RestResponse with Retry-After when the node's overload
+    controller sheds this request, None to admit."""
+    from elasticsearch_tpu.threadpool import (
+        EsRejectedExecutionError, pool_for_request, tier_for_request,
+    )
+
+    def admission(method: str, path: str, params: Dict[str, str]):
+        if pool_for_request(method, path) not in ("search", "write", "get"):
+            return None
+        tier = tier_for_request(method, path, params)
+        retry_after = node.overload.admit(tier)
+        if retry_after is None:
+            return None
+        err = EsRejectedExecutionError(
+            f"[{node.node_name}] overload shed "
+            f"({node.overload.stats()['level']}): {tier}-tier request on "
+            f"[{path}]", tier=tier, retry_after_s=retry_after)
+        return RestResponse(status=err.status, body=_error_body(err),
+                            headers={"Retry-After":
+                                     str(max(1, int(retry_after)))})
+
+    return admission
 
 
 def _tpu_compile_stats() -> dict:

@@ -22,7 +22,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qsl, urlsplit
 
 from elasticsearch_tpu.rest.controller import (
-    RestController, RestResponse, _error_body,
+    RestController, RestResponse, _backoff_headers, _error_body,
 )
 
 
@@ -61,12 +61,15 @@ class HttpServer:
                             headers=dict(self.headers))
                     except EsRejectedExecutionError as e:
                         resp = RestResponse(status=e.status,
-                                            body=_error_body(e))
+                                            body=_error_body(e),
+                                            headers=_backoff_headers(e))
                 data = resp.encode()
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("X-elastic-product", "Elasticsearch")
+                for name, value in resp.headers.items():
+                    self.send_header(name, value)
                 self.end_headers()
                 if self.command != "HEAD":
                     self.wfile.write(data)

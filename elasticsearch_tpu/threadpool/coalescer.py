@@ -277,6 +277,11 @@ class DispatchCoalescer:
                 batch.results = self._run(engine, batch.queries, batch.k,
                                           fault_log=batch.fault_log)
                 record_device(engine, n, (time.monotonic() - t_dev) * 1e3)
+                from elasticsearch_tpu.common.overload import (
+                    default_overload,
+                )
+
+                default_overload().note_success()
             except Exception as e:
                 # poison-batch containment: a failed FUSED dispatch must
                 # not fail every waiter — retry each query solo once so
@@ -315,6 +320,13 @@ class DispatchCoalescer:
 
     def _retry_solo(self, batch: _PendingBatch,
                     original: BaseException) -> None:
+        from elasticsearch_tpu.common.overload import default_overload
+
+        if not default_overload().retry_allowed("coalesce_solo"):
+            # retry budget exhausted: every waiter gets the ORIGINAL
+            # batch error instead of N solo re-dispatches
+            batch.error = original
+            return
         with self._lock:
             self._batch_retries += 1
         retry_batch_solo(batch, original)

@@ -112,9 +112,11 @@ class FixedExecutor:
         task = _Task(fn, args, kwargs)
         with self._lock:
             if self._shutdown:
+                self.rejected += 1
                 raise EsRejectedExecutionError(
                     f"rejected execution of task on [{self.name}]: "
-                    f"executor is shut down", bucket=self.name)
+                    f"executor is shut down", bucket=self.name,
+                    retry_after_s=self._retry_after_s())
             busy = self._idle == 0
             if busy and len(self._threads) >= self.size \
                     and len(self._queue) >= self.queue_size:
@@ -122,7 +124,8 @@ class FixedExecutor:
                 raise EsRejectedExecutionError(
                     f"rejected execution of task on [{self.name}]: "
                     f"pool size [{self.size}] active and queue capacity "
-                    f"[{self.queue_size}] full", bucket=self.name)
+                    f"[{self.queue_size}] full", bucket=self.name,
+                    retry_after_s=self._retry_after_s())
             if busy and len(self._threads) < self.size:
                 t = threading.Thread(
                     target=self._worker, daemon=True,
@@ -132,6 +135,11 @@ class FixedExecutor:
             self._queue.append(task)
             self._work.notify()
         return task
+
+    def _retry_after_s(self) -> int:
+        """Backoff hint for 429 rejections: how long the queue has been
+        making tasks wait, rounded up (caller holds _lock)."""
+        return min(30, 1 + int(self.queue_ewma_ms // 1000))
 
     def _worker(self) -> None:
         _tls.executor = self

@@ -498,12 +498,24 @@ class AdaptiveDispatchScheduler:
                     fault_log=batch.fault_log)
                 record_device(batch.engine, n,
                               (time.monotonic() - t_dev) * 1e3)
+                from elasticsearch_tpu.common.overload import (
+                    default_overload,
+                )
+
+                default_overload().note_success()
         except Exception as e:
             # poison-batch containment (coalescer parity): retry each
-            # query solo so only the one tripping the fault sees it
-            with self._lock:
-                self._batch_retries += 1
-            retry_batch_solo(batch, e)
+            # query solo so only the one tripping the fault sees it —
+            # but only while the node-wide retry budget holds out; an
+            # exhausted budget ferries the ORIGINAL error to the waiters
+            from elasticsearch_tpu.common.overload import default_overload
+
+            if not default_overload().retry_allowed("sched_solo"):
+                batch.error = e
+            else:
+                with self._lock:
+                    self._batch_retries += 1
+                retry_batch_solo(batch, e)
         except BaseException as e:  # noqa: BLE001 — ferried to waiters
             batch.error = e
         finally:

@@ -1,0 +1,493 @@
+"""Overload control plane tests (PR 13).
+
+Covers the three consumers of `common/overload.py` plus the satellite
+fixes that rode along:
+
+* controller levels, deterministic fault injection, hysteresis (no
+  GREEN<->RED flapping under a square-wave load — fake clock, no sleeps);
+* `RetryBudget` token-bucket semantics (spend / refill / cap / disable);
+* pool rejection satellites: shutdown-path rejections are counted and
+  every `EsRejectedExecutionError` carries a `retry_after_s` hint;
+* breaker satellites: the trip message reports bytes-wanted vs bytes
+  already used, and a parent-level trip increments the PARENT's
+  trip_count (visible in the hierarchy service's stats());
+* REST seeded overload-storm differential: admitted queries stay
+  bit-identical to an unloaded run, shed requests are clean 429s with
+  Retry-After, every shed is counted;
+* retry-budget fail-fast differential on the distributed harness: a
+  seeded rpc_query storm is bounded by the budget (the organic error
+  surfaces), while the ratio=0 run retries without bound;
+* pressure propagation: data nodes piggyback their level on shard RPC
+  responses and `_rank_copies` demotes overloaded replicas;
+* chaos lane: overload shedding interleaved with a primary crash +
+  restart loses no acked write (linearizability check).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.common import faults, metrics, overload
+from elasticsearch_tpu.common.breaker import (
+    CircuitBreaker, CircuitBreakingError, HierarchyCircuitBreakerService,
+)
+from elasticsearch_tpu.common.durability import reset_for_tests
+from elasticsearch_tpu.common.faults import inject
+from elasticsearch_tpu.common.overload import OverloadController, RetryBudget
+from elasticsearch_tpu.threadpool.pool import (
+    EsRejectedExecutionError, FixedExecutor,
+)
+
+MAPPINGS = {"properties": {"n": {"type": "integer"},
+                           "body": {"type": "text"}}}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    overload.reset_default_for_tests()
+    yield
+    faults.clear()
+    overload.reset_default_for_tests()
+
+
+def make_controller(**kw):
+    """Controller on a fake clock so hysteresis tests need no sleeps."""
+    t = {"now": 0.0}
+    ctl = OverloadController("test", clock=lambda: t["now"], **kw)
+    return ctl, t
+
+
+# ------------------------------------------------------------ level folding
+
+
+def test_green_by_default_and_signals_normalized():
+    ctl, _ = make_controller()
+    assert ctl.evaluate() == "green"
+    st = ctl.stats()
+    assert st["level"] == "green"
+    # the hbm signal reads the process-global ledger, which other suites
+    # may have touched — advisory weighting keeps it far from YELLOW
+    assert st["score"] < 0.5
+    # unwired signals read 0, never None/missing
+    for k in ("pool_queue", "queue_wait", "scheduler", "breaker",
+              "indexing"):
+        assert st["signals"][k] == 0.0
+    assert 0.0 <= st["signals"]["hbm"] <= 1.0
+
+
+def test_injected_levels_map_hang_yellow_raise_red(monkeypatch):
+    monkeypatch.setenv("ES_TPU_OVERLOAD_HYSTERESIS_MS", "0")
+    ctl, _ = make_controller()
+    with inject("overload_pressure:hang@1x1"):
+        assert ctl.evaluate() == "yellow"
+    assert ctl.evaluate() == "green"   # clause consumed, hysteresis off
+    with inject("overload_pressure:raise@1x1"):
+        assert ctl.evaluate() == "red"
+    with inject("overload_pressure:oom@1x1"):
+        assert ctl.evaluate() == "red"
+    assert ctl.evaluate() == "green"
+    assert "green->red" in ctl.stats()["transitions"]
+
+
+def test_stats_reports_cached_level_without_consuming_injection():
+    ctl, _ = make_controller()
+    with inject("overload_pressure:raise@1x1"):
+        # stats() must not consume the single injected fire
+        for _ in range(5):
+            assert ctl.stats()["level"] == "green"
+        assert ctl.evaluate() == "red"
+
+
+def test_hysteresis_square_wave_no_flapping(monkeypatch):
+    """A 0.2s-period square wave against a 500ms hysteresis window must
+    hold RED (upgrades immediate, downgrades deferred), then decay to
+    GREEN only after the raw level stays below for the full window."""
+    monkeypatch.setenv("ES_TPU_OVERLOAD_HYSTERESIS_MS", "500")
+    ctl, t = make_controller()
+    for _ in range(6):
+        with inject("overload_pressure:raise@1x1"):
+            assert ctl.evaluate() == "red"
+        t["now"] += 0.1
+        # raw green, but inside the hysteresis window: level holds
+        assert ctl.evaluate() == "red"
+        t["now"] += 0.1
+    assert ctl.stats()["transitions"] == ["green->red"], \
+        "square wave must not flap GREEN<->RED"
+    # sustained green for > window: downgrade exactly once
+    assert ctl.evaluate() == "red"
+    t["now"] += 0.6
+    assert ctl.evaluate() == "green"
+    assert ctl.stats()["transitions"] == ["green->red", "red->green"]
+
+
+# ------------------------------------------------------------- retry budget
+
+
+def test_retry_budget_spend_refill_cap(monkeypatch):
+    monkeypatch.setenv("ES_TPU_RETRY_BUDGET_CAP", "3")
+    monkeypatch.setenv("ES_TPU_RETRY_BUDGET_RATIO", "0.5")
+    b = RetryBudget()   # cap read at construction = initial fill
+    assert [b.allow("s") for _ in range(3)] == [True, True, True]
+    assert b.allow("s") is False
+    assert b.allow("other") is False
+    st = b.stats()
+    assert st["consumed"] == 3
+    assert st["exhausted"] == {"s": 1, "other": 1}
+    assert st["exhausted_total"] == 2
+    # one success refills ratio=0.5: still below a whole token
+    b.note_success()
+    assert b.allow("s") is False
+    b.note_success()
+    assert b.allow("s") is True      # 1.0 token accumulated
+    # refills cap at ES_TPU_RETRY_BUDGET_CAP
+    for _ in range(100):
+        b.note_success()
+    assert b.stats()["tokens"] == 3.0
+
+
+def test_retry_budget_ratio_zero_disables(monkeypatch):
+    monkeypatch.setenv("ES_TPU_RETRY_BUDGET_CAP", "1")
+    monkeypatch.setenv("ES_TPU_RETRY_BUDGET_RATIO", "0")
+    b = RetryBudget()
+    assert all(b.allow("s") for _ in range(50))
+    st = b.stats()
+    assert st["consumed"] == 0 and st["exhausted_total"] == 0
+
+
+# -------------------------------------------------- pool rejection satellites
+
+
+def test_pool_shutdown_rejection_counted_with_retry_after():
+    ex = FixedExecutor("probe", 1, 4)
+    ex.shutdown()
+    with pytest.raises(EsRejectedExecutionError) as ei:
+        ex.submit(lambda: None)
+    assert ei.value.metadata["retry_after_s"] >= 1
+    assert ex.stats()["rejected"] == 1, \
+        "shutdown-path rejection must bump the rejected counter"
+
+
+def test_pool_queue_full_rejection_carries_retry_after():
+    ex = FixedExecutor("probe", 1, 0)
+    started, release = threading.Event(), threading.Event()
+
+    def block():
+        started.set()
+        release.wait(5)
+
+    ex.submit(block)
+    assert started.wait(5)
+    try:
+        with pytest.raises(EsRejectedExecutionError) as ei:
+            ex.submit(lambda: None)
+        assert ei.value.metadata["retry_after_s"] >= 1
+        assert ex.stats()["rejected"] == 1
+    finally:
+        release.set()
+        ex.shutdown()
+
+
+# --------------------------------------------------------- breaker satellites
+
+
+def test_breaker_trip_message_wanted_vs_already_used():
+    br = CircuitBreaker("request", limit_bytes=100)
+    br.add_estimate_bytes_and_maybe_break(60, "chunk-a")
+    with pytest.raises(CircuitBreakingError) as ei:
+        br.add_estimate_bytes_and_maybe_break(60, "chunk-b")
+    msg = str(ei.value)
+    assert "wanted [60b] on top of [60b] already used" in msg
+    assert "[120b]" in msg and "[100b]" in msg
+    assert ei.value.metadata["bytes_wanted"] == 60
+    assert ei.value.metadata["bytes_used"] == 60
+    assert ei.value.metadata["bytes_limit"] == 100
+    # failed reservation rolled back, trip recorded
+    assert br.used_bytes == 60
+    assert br.trip_count == 1
+
+
+def test_parent_trip_increments_parent_count_and_rolls_back_child():
+    parent = CircuitBreaker("parent", 100)
+    child = CircuitBreaker("request", 1000, parent=parent)
+    with pytest.raises(CircuitBreakingError):
+        child.add_estimate_bytes_and_maybe_break(150, "big")
+    assert parent.trip_count == 1
+    assert child.trip_count == 0
+    assert child.used_bytes == 0 and parent.used_bytes == 0
+
+
+def test_hierarchy_service_stats_show_parent_trip():
+    svc = HierarchyCircuitBreakerService(total_limit_bytes=1000)
+    # fill the parent via untracked child reservations, then let a small
+    # tracked add trip the PARENT (each child stays under its own limit)
+    svc.get_breaker("request").add_without_breaking(950)
+    with pytest.raises(CircuitBreakingError):
+        svc.get_breaker("fielddata").add_estimate_bytes_and_maybe_break(
+            60, "agg")
+    st = svc.stats()
+    assert st["parent"]["tripped"] == 1
+    assert st["fielddata"]["tripped"] == 0
+
+
+# ------------------------------------------------ REST admission differential
+
+
+@pytest.fixture()
+def api():
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest import RestController, register_handlers
+
+    node = Node()
+    rc = RestController()
+    register_handlers(node, rc)
+
+    def call(method, path, body=None, params=None):
+        if isinstance(body, (dict, list)):
+            body = json.dumps(body)
+        return rc.dispatch(method, path, params or {}, body)
+
+    yield call, node
+    node.close()
+
+
+def test_rest_storm_differential_bit_identical(api, monkeypatch):
+    """`overload_pressure:raise@3x2` sheds exactly the 3rd and 4th
+    admission checks: those two searches come back as clean 429s with
+    Retry-After; every admitted search is bit-identical to the unloaded
+    baseline; every shed is counted."""
+    monkeypatch.setenv("ES_TPU_OVERLOAD_HYSTERESIS_MS", "0")
+    call, node = api
+    for i in range(8):
+        call("PUT", f"/idx/_doc/{i}",
+             {"n": i, "body": f"word{i % 3} common text"})
+    call("POST", "/idx/_refresh")
+    body = {"query": {"match": {"body": "common"}}, "size": 5}
+    baseline = [call("POST", "/idx/_search", body) for _ in range(6)]
+    assert all(r.status == 200 for r in baseline)
+    shed_before = metrics.counter_values()["overload_shed"]
+
+    with inject("overload_pressure:raise@3x2"):
+        results = [call("POST", "/idx/_search", body) for _ in range(6)]
+
+    for i, r in enumerate(results):
+        if i in (2, 3):
+            assert r.status == 429
+            assert r.body["error"]["type"] == "es_rejected_execution_exception"
+            assert int(r.headers["Retry-After"]) >= 1
+        else:
+            assert r.status == 200
+            assert r.body["hits"] == baseline[i].body["hits"], \
+                "admitted queries must stay bit-identical under brownout"
+    assert metrics.counter_values()["overload_shed"] - shed_before == 2
+
+    # nodes-stats surface + Prometheus exposition
+    st = node.overload.stats()
+    assert st["shed"]["total"] == 2
+    assert "green->red" in st["transitions"]
+    r = call("GET", "/_nodes/stats")
+    (node_stats,) = r.body["nodes"].values()
+    assert node_stats["tpu_overload"]["shed"]["total"] == 2
+    text = metrics.render_prometheus({"n": metrics.scrape_payload()}, [])
+    assert "es_tpu_tpu_overload_level" in text
+    assert "es_tpu_overload_shed_total" in text
+
+
+def test_rest_yellow_sheds_bulk_keeps_interactive(api, monkeypatch):
+    """Brownout ladder at YELLOW: bulk tier 429s (Retry-After set, nothing
+    written), interactive searches and management endpoints stay admitted."""
+    monkeypatch.setenv("ES_TPU_OVERLOAD_HYSTERESIS_MS", "0")
+    call, node = api
+    call("PUT", "/lib/_doc/1", {"n": 1, "body": "hello world"})
+    call("POST", "/lib/_refresh")
+    bulk = "\n".join([
+        json.dumps({"index": {"_index": "lib", "_id": "9"}}),
+        json.dumps({"n": 9, "body": "shed me"}),
+    ]) + "\n"
+    with inject("overload_pressure:hang@1xinf"):
+        r = call("POST", "/_bulk", bulk)
+        assert r.status == 429
+        assert int(r.headers["Retry-After"]) >= 1
+        assert r.body["error"]["type"] == "es_rejected_execution_exception"
+        r = call("GET", "/lib/_search", {"query": {"match_all": {}}})
+        assert r.status == 200, "interactive admitted at YELLOW"
+        # management plane must stay reachable mid-brownout
+        assert call("GET", "/_nodes/stats").status == 200
+    # the shed bulk wrote nothing
+    call("POST", "/lib/_refresh")
+    r = call("GET", "/lib/_count")
+    assert r.body["count"] == 1
+    st = node.overload.stats()
+    assert st["shed"]["bulk"] >= 1 and st["shed"]["interactive"] == 0
+
+
+# --------------------------------------- retry-budget fail-fast differential
+
+
+def test_retry_budget_bounds_failover_storm(monkeypatch):
+    """Seeded rpc_query storm on a 1-shard/1-replica index: with a 3-token
+    budget the failover loop performs exactly 3 retries then fails fast
+    with the ORGANIC transport error; flipping the ratio knob to 0 on the
+    same cluster restores unbounded (one-per-search) retries."""
+    from elasticsearch_tpu.action.search_action import _COORD_COUNTERS
+    from elasticsearch_tpu.cluster_node import form_local_cluster
+
+    monkeypatch.setenv("ES_TPU_OVERLOAD_HYSTERESIS_MS", "0")
+    monkeypatch.setenv("ES_TPU_RETRY_BUDGET_CAP", "3")
+    monkeypatch.setenv("ES_TPU_RETRY_BUDGET_RATIO", "0.001")
+    # keep the per-node transport circuit out of the way: this test wants
+    # the retry BUDGET to be the binding constraint, not quarantine
+    monkeypatch.setenv("ES_TPU_HEALTH_TRIP_N", "1000")
+    nodes, store, channels = form_local_cluster(
+        ["m0", "d0", "d1"], roles={"m0": ("master",)})
+    master, a, b = nodes
+    a.create_index("docs", {"settings": {"number_of_shards": 1,
+                                         "number_of_replicas": 1},
+                            "mappings": MAPPINGS})
+    resp = a.bulk("docs", [{"op": "index", "id": f"x{i}",
+                            "source": {"n": i, "body": "text"}}
+                           for i in range(4)])
+    assert not resp["errors"]
+    a.refresh("docs")
+
+    def storm(n):
+        before = _COORD_COUNTERS["shard_retries"]
+        with inject("rpc_query:raise@1xinf"):
+            for _ in range(n):
+                r = a.search("docs", {"query": {"match_all": {}}})
+                assert r["_shards"]["failed"] == 1
+                reason = r["_shards"]["failures"][0]["reason"]
+                # fail-fast surfaces the organic transport error, never a
+                # budget-shaped one
+                assert reason["type"] == "node_not_connected_exception"
+                assert "budget" not in json.dumps(r).lower()
+        return _COORD_COUNTERS["shard_retries"] - before
+
+    # budgeted: 3 tokens -> 3 failover retries total across 10 searches
+    assert storm(10) == 3
+    st = a.overload.stats()["retry_budget"]
+    assert st["exhausted"]["shard_failover"] == 7
+    assert st["tokens"] < 1
+
+    # knob off: every search retries the second copy (10 retries for 10)
+    monkeypatch.setenv("ES_TPU_RETRY_BUDGET_RATIO", "0")
+    assert storm(10) == 10
+
+
+# ------------------------------------------------------ pressure propagation
+
+
+def test_pressure_piggyback_and_replica_demotion(monkeypatch):
+    """Data nodes piggyback their level on shard RPC responses; the
+    coordinator remembers it and `_rank_copies` demotes pressured copies
+    (even the local one) until the signal ages out."""
+    from elasticsearch_tpu.action.search_action import _COORD_COUNTERS
+    from elasticsearch_tpu.cluster_node import form_local_cluster
+
+    monkeypatch.setenv("ES_TPU_OVERLOAD_HYSTERESIS_MS", "0")
+    nodes, store, channels = form_local_cluster(
+        ["m0", "d0", "d1"], roles={"m0": ("master",)})
+    master, a, b = nodes
+    a.create_index("docs", {"settings": {"number_of_shards": 1,
+                                         "number_of_replicas": 1},
+                            "mappings": MAPPINGS})
+    a.bulk("docs", [{"op": "index", "id": "1",
+                     "source": {"n": 1, "body": "hello"}}])
+    a.refresh("docs")
+
+    # integration: a YELLOW data node piggybacks its level; interactive
+    # searches stay admitted at YELLOW so the response is full-fidelity
+    with inject("overload_pressure:hang@1xinf"):
+        r = a.search("docs", {"query": {"match_all": {}}})
+    assert r["_shards"]["failed"] == 0
+    assert r["hits"]["total"]["value"] == 1
+    sa = a.search_action
+    assert any(lvl == "yellow" for lvl, _ in sa._node_pressure.values())
+
+    # unit: a RED mark on the LOCAL node outranks locality
+    copies = store.current().shard_copies("docs", 0)
+    assert {c.node_id for c in copies} == {"d0", "d1"}
+    sa._node_pressure.clear()
+    sa._note_node_pressure("d0", "red")
+    before = _COORD_COUNTERS["overload_reroutes"]
+    assert sa._rank_copies(copies)[0] == "d1"
+    assert _COORD_COUNTERS["overload_reroutes"] - before == 1
+
+    # stale signals age out (TTL = max(1s, 2x hysteresis)): rank reverts
+    sa._node_pressure["d0"] = ("red", time.monotonic() - 30.0)
+    assert sa._rank_copies(copies)[0] == "d0"
+
+
+# ----------------------------------------------------------------- chaos lane
+
+
+def write_op(doc_id, value):
+    return {"op": "index", "id": doc_id,
+            "source": {"n": value, "body": f"v{value}"}}
+
+
+def test_chaos_shedding_with_crash_restart_keeps_acked_writes(
+        tmp_path, monkeypatch):
+    """Overload shedding interleaved with a primary crash + restart: a
+    shed bulk rejects the WHOLE request before any op applies (nothing
+    acked), so the acked-write linearizability check still passes."""
+    from elasticsearch_tpu.testing.chaos import (
+        AckedWriteHistory, CrashRestartCluster,
+    )
+
+    monkeypatch.setenv("ES_TPU_OVERLOAD_HYSTERESIS_MS", "0")
+    reset_for_tests()
+    try:
+        cluster = CrashRestartCluster(["m0", "d0", "d1", "d2"],
+                                      str(tmp_path),
+                                      roles={"m0": ("master",)})
+        cluster.master().create_index(
+            "docs", {"settings": {"number_of_shards": 1,
+                                  "number_of_replicas": 1},
+                     "mappings": MAPPINGS})
+        history = AckedWriteHistory()
+        docs = [f"doc{i}" for i in range(6)]
+
+        def guarded_bulk(value):
+            ops = [write_op(d, value) for d in docs]
+            pending = [(op, history.invoke(op["id"], "write",
+                                           op["source"]["n"]))
+                       for op in ops]
+            try:
+                resp = cluster.master().bulk("docs", list(ops))
+            except EsRejectedExecutionError:
+                # shed at admission, before ANY op applied: nothing acked
+                return set()
+            acked = set()
+            for (op, op_id), item in zip(pending, resp["items"]):
+                if item is not None and "error" not in item:
+                    history.respond(op["id"], op_id)
+                    acked.add(op["id"])
+            return acked
+
+        def primary_node():
+            for r in cluster.store.current().shard_copies("docs", 0):
+                if r.primary and r.state == "STARTED":
+                    return r.node_id
+            return None
+
+        assert guarded_bulk(1) == set(docs)          # green: all acked
+        with inject("overload_pressure:hang@1xinf"):
+            assert guarded_bulk(2) == set()          # yellow: whole bulk shed
+        victim = primary_node()
+        assert cluster.node(victim).overload.stats()["shed"]["bulk"] >= 1
+        cluster.crash(victim)                        # promotion
+        assert guarded_bulk(3) == set(docs)          # acked on new primary
+        cluster.restart(victim)                      # peer recovery
+        with inject("overload_pressure:hang@1xinf"):
+            assert guarded_bulk(4) == set()          # shed again post-restart
+        faults.clear()
+        for d in docs:
+            src = cluster.read_doc("docs", d)
+            history.record_read(d, None if src is None else src["n"])
+        assert history.check() == [], \
+            "an acked write vanished across shed/crash/restart interleaving"
+    finally:
+        reset_for_tests()
