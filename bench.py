@@ -1550,6 +1550,129 @@ def dryrun_overload() -> int:
     return 0 if ok else 1
 
 
+def dryrun_relocation() -> int:
+    """Rolling-maintenance smoke (PR 14): 2-data-node in-process mesh,
+    drain one node (PUT /_cluster/settings exclude filter) while search
+    and bulk traffic keeps flowing. Every admitted request must succeed
+    (zero 5xx-equivalent errors), the post-drain top-k must agree 1.0
+    with the pre-drain answer over the SAME corpus, the drained node
+    must end empty with the cluster green and zero relocating shards,
+    and the tpu_relocation counters must show the moves. One JSON line
+    on stdout; exit 0/1."""
+    import threading
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.cluster.allocation import EXCLUDE_NAME_SETTING
+    from elasticsearch_tpu.cluster_node import form_local_cluster
+    from elasticsearch_tpu.common.relocation import (
+        relocation_stats, reset_for_tests,
+    )
+
+    reset_for_tests()
+    log("dryrun_relocation: forming 2-data-node cluster...")
+    nodes, store, channels = form_local_cluster(
+        ["m0", "d0", "d1"], roles={"m0": ("master",)})
+    master, a, b = nodes
+    a.create_index("docs", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 0},
+        "mappings": {"properties": {"n": {"type": "integer"},
+                                    "body": {"type": "text"}}}})
+    a.bulk("docs", [{"op": "index", "id": str(i),
+                     "source": {"n": i, "body": f"word{i % 7} common text"}}
+                    for i in range(80)])
+    a.refresh("docs")
+    body = {"query": {"match": {"body": "common"}}, "size": 10,
+            "track_total_hits": True}
+    baseline = a.search("docs", body)
+    base_ids = [h["_id"] for h in baseline["hits"]["hits"]]
+
+    errors: list = []
+    searched = [0]
+    written = [0]
+    stop = threading.Event()
+
+    def search_loop():
+        while not stop.is_set():
+            try:
+                r = b.search("docs", body)
+                if r["_shards"]["failed"]:
+                    errors.append(("search_shards", r["_shards"]))
+                searched[0] += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(("search", repr(e)))
+
+    def bulk_loop():
+        i = 1000
+        while not stop.is_set():
+            try:
+                r = a.bulk("docs", [{
+                    "op": "index", "id": f"x{i}",
+                    "source": {"n": i, "body": "background common text"}}],
+                    retries=3)
+                if r["errors"]:
+                    errors.append(("bulk", r["items"]))
+                written[0] += 1
+                i += 1
+            except Exception as e:  # noqa: BLE001
+                errors.append(("bulk", repr(e)))
+
+    threads = [threading.Thread(target=search_loop),
+               threading.Thread(target=bulk_loop)]
+    for t in threads:
+        t.start()
+    log("dryrun_relocation: draining d0 under load...")
+    master.update_cluster_settings({EXCLUDE_NAME_SETTING: "d0"})
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        st = store.current()
+        if not st.entries_on_node("d0") \
+                and st.health()["relocating_shards"] == 0:
+            break
+        time.sleep(0.05)
+    time.sleep(0.2)        # a little more traffic on the new layout
+    stop.set()
+    for t in threads:
+        t.join()
+
+    st = store.current()
+    h = st.health()
+    # top-k agreement over the SAME corpus: background writes add docs,
+    # so compare the baseline query restricted to the original ids
+    a.refresh("docs")
+    after = a.search("docs", {
+        "query": {"bool": {"must": [{"match": {"body": "common"}}],
+                           "filter": [{"range": {"n": {"lt": 100}}}]}},
+        "size": 10, "track_total_hits": True})
+    after_ids = [x["_id"] for x in after["hits"]["hits"]]
+    agreement = (sum(1 for x, y in zip(after_ids, base_ids) if x == y)
+                 / max(1, len(base_ids)))
+    stats = relocation_stats()
+    drained_empty = not st.entries_on_node("d0")
+    ok = (not errors and drained_empty
+          and h["status"] == "green" and h["relocating_shards"] == 0
+          and agreement == 1.0 and stats["moves"] >= 1
+          and searched[0] > 0 and written[0] > 0)
+    print(json.dumps({
+        "metric": "dryrun_relocation",
+        "ok": bool(ok),
+        "admitted_errors": len(errors),
+        "searches": searched[0],
+        "bulks": written[0],
+        "drained_empty": bool(drained_empty),
+        "status": h["status"],
+        "relocating_shards": int(h["relocating_shards"]),
+        "topk_agreement": agreement,
+        "moves": int(stats["moves"]),
+        "cancels": int(stats["cancels"]),
+    }), flush=True)
+    log(f"dryrun_relocation: errors={len(errors)} moves={stats['moves']} "
+        f"agreement={agreement}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -1578,4 +1701,7 @@ if __name__ == "__main__":
     if "dryrun_overload" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_overload":
         sys.exit(dryrun_overload())
+    if "dryrun_relocation" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_relocation":
+        sys.exit(dryrun_relocation())
     main()

@@ -900,7 +900,7 @@ class SearchActionService:
                 raise IndexClosedError(f"closed index [{index}]")
             for sid in range(meta.number_of_shards):
                 copies = [r for r in state.shard_copies(index, sid)
-                          if r.state == "STARTED" and r.node_id is not None]
+                          if r.serving and r.node_id is not None]
                 if not copies:
                     raise ElasticsearchTpuError(
                         f"all shards failed: no started copy of "

@@ -45,18 +45,45 @@ class ShardRouting:
     primary: bool
     state: str = "STARTED"     # UNASSIGNED | INITIALIZING | STARTED | RELOCATING
     allocation_id: str = ""
+    # relocation linkage (ref: ShardRouting.relocatingNodeId): on the
+    # RELOCATING source this names the target node; on the INITIALIZING
+    # target it names the source node.
+    relocating_node_id: Optional[str] = None
+    # delayed allocation (ref: UnassignedInfo.delayed): an UNASSIGNED
+    # replacement left behind by node-left is not allocatable before this
+    # wall-clock deadline, giving the bounced node a window to rejoin.
+    delayed_until_ms: Optional[int] = None
+    # the node that last held this copy — a rejoining node reclaims its
+    # own delayed copies instead of triggering a copy storm
+    last_node_id: Optional[str] = None
+
+    @property
+    def serving(self) -> bool:
+        """A copy that answers reads: STARTED, or a RELOCATING source that
+        keeps serving until the target takes over."""
+        return self.state in ("STARTED", "RELOCATING")
 
     def to_dict(self) -> dict:
-        return {"index": self.index, "shard_id": self.shard_id,
-                "node_id": self.node_id, "primary": self.primary,
-                "state": self.state, "allocation_id": self.allocation_id}
+        d = {"index": self.index, "shard_id": self.shard_id,
+             "node_id": self.node_id, "primary": self.primary,
+             "state": self.state, "allocation_id": self.allocation_id}
+        if self.relocating_node_id is not None:
+            d["relocating_node_id"] = self.relocating_node_id
+        if self.delayed_until_ms is not None:
+            d["delayed_until_ms"] = self.delayed_until_ms
+        if self.last_node_id is not None:
+            d["last_node_id"] = self.last_node_id
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "ShardRouting":
         return ShardRouting(index=d["index"], shard_id=d["shard_id"],
                             node_id=d.get("node_id"), primary=d["primary"],
                             state=d.get("state", "STARTED"),
-                            allocation_id=d.get("allocation_id", ""))
+                            allocation_id=d.get("allocation_id", ""),
+                            relocating_node_id=d.get("relocating_node_id"),
+                            delayed_until_ms=d.get("delayed_until_ms"),
+                            last_node_id=d.get("last_node_id"))
 
 
 @dataclass(frozen=True)
@@ -132,8 +159,21 @@ class ClusterState:
     nodes: Dict[str, DiscoveryNode] = field(default_factory=dict)
     indices: Dict[str, IndexMetadata] = field(default_factory=dict)
     routing: Dict[str, List[ShardRouting]] = field(default_factory=dict)
+    # cluster-wide persistent settings (ref: Metadata persistentSettings) —
+    # allocation filters like cluster.routing.allocation.exclude._name live
+    # here so every master sees the same drain intent
+    settings: Dict[str, str] = field(default_factory=dict)
 
     # ---- functional updaters ----
+
+    def with_settings(self, updates: Dict[str, Optional[str]]) -> "ClusterState":
+        merged = dict(self.settings)
+        for k, v in updates.items():
+            if v is None or v == "":
+                merged.pop(k, None)
+            else:
+                merged[k] = str(v)
+        return replace(self, version=self.version + 1, settings=merged)
 
     def with_index(self, meta: IndexMetadata, routing: List[ShardRouting]) -> "ClusterState":
         indices = dict(self.indices)
@@ -176,10 +216,17 @@ class ClusterState:
         return [r for r in self.routing.get(index, []) if r.shard_id == shard_id]
 
     def primary_of(self, index: str, shard_id: int) -> Optional[ShardRouting]:
+        # during primary relocation two entries carry the primary flag
+        # (RELOCATING source + INITIALIZING target); the serving one is
+        # authoritative for writes until the swap commits
+        best: Optional[ShardRouting] = None
         for r in self.routing.get(index, []):
             if r.shard_id == shard_id and r.primary:
-                return r
-        return None
+                if r.serving:
+                    return r
+                if best is None:
+                    best = r
+        return best
 
     def entries_on_node(self, node_id: str) -> List[ShardRouting]:
         return [r for shards in self.routing.values() for r in shards
@@ -203,6 +250,7 @@ class ClusterState:
             "indices": {name: m.to_dict() for name, m in self.indices.items()},
             "routing": {name: [r.to_dict() for r in shards]
                         for name, shards in self.routing.items()},
+            "settings": dict(self.settings),
         }
 
     @staticmethod
@@ -218,6 +266,7 @@ class ClusterState:
                      for name, m in d.get("indices", {}).items()},
             routing={name: [ShardRouting.from_dict(r) for r in shards]
                      for name, shards in d.get("routing", {}).items()},
+            settings=dict(d.get("settings", {})),
         )
 
     def resolve_indices(self, expression: str) -> List[str]:
@@ -251,24 +300,44 @@ class ClusterState:
                             matched = True
         return out
 
-    def health(self) -> dict:
-        """Ref: cluster health computation — green/yellow/red from routing."""
+    def health(self, now_ms: Optional[int] = None) -> dict:
+        """Ref: cluster health computation — green/yellow/red from routing.
+
+        RELOCATING sources still serve reads and writes, so they count as
+        active; red means some shard has NO serving primary (neither
+        STARTED nor RELOCATING)."""
+        if now_ms is None:
+            now_ms = int(time.time() * 1000)
         active_primary = 0
         active = 0
         unassigned = 0
         initializing = 0
-        for shards in self.routing.values():
+        relocating = 0
+        delayed = 0
+        served: Dict[Any, bool] = {}
+        for index, shards in self.routing.items():
             for s in shards:
-                if s.state == "STARTED":
+                key = (index, s.shard_id)
+                served.setdefault(key, False)
+                if s.state == "RELOCATING":
+                    relocating += 1
+                if s.serving:
                     active += 1
                     if s.primary:
                         active_primary += 1
+                        served[key] = True
                 elif s.state == "INITIALIZING":
-                    initializing += 1
+                    # a relocation target is the move's other half — the
+                    # RELOCATING source already counts as active, so the
+                    # target neither drives yellow nor inflates totals
+                    if s.relocating_node_id is None:
+                        initializing += 1
                 else:
                     unassigned += 1
-        if any(s.primary and s.state != "STARTED"
-               for shards in self.routing.values() for s in shards):
+                    if (s.delayed_until_ms is not None
+                            and s.delayed_until_ms > now_ms):
+                        delayed += 1
+        if any(not ok for ok in served.values()):
             status = "red"
         elif unassigned or initializing:
             status = "yellow"
@@ -283,10 +352,10 @@ class ClusterState:
             "number_of_data_nodes": sum(1 for n in self.nodes.values() if "data" in n.roles),
             "active_primary_shards": active_primary,
             "active_shards": active,
-            "relocating_shards": 0,
+            "relocating_shards": relocating,
             "initializing_shards": initializing,
             "unassigned_shards": unassigned,
-            "delayed_unassigned_shards": 0,
+            "delayed_unassigned_shards": delayed,
             "number_of_pending_tasks": 0,
             "number_of_in_flight_fetch": 0,
             "task_max_waiting_in_queue_millis": 0,

@@ -64,6 +64,9 @@ class ClusterNode:
         self.store = store
         self.transport = transport or TransportService(node_name)
         self.allocation = AllocationService()
+        # armed by node-left when delayed allocation is on; each fires one
+        # reroute through the master update queue at deadline expiry
+        self._delayed_timers: List[threading.Timer] = []
         from elasticsearch_tpu.common.indexing_pressure import IndexingPressure
 
         # ONE write-backpressure budget per node: the coordinating stage
@@ -135,6 +138,10 @@ class ClusterNode:
                                    self._on_node_left)
         t.register_request_handler("internal:cluster/node/join",
                                    self._on_node_join)
+        t.register_request_handler("cluster:admin/settings/update",
+                                   self._on_cluster_settings_update)
+        t.register_request_handler("cluster:admin/reroute",
+                                   self._on_cluster_reroute)
         t.register_request_handler("cluster:monitor/health",
                                    lambda req: self.state.health())
         t.register_request_handler("cluster:monitor/nodes/ping",
@@ -310,6 +317,7 @@ class ClusterNode:
             return self.allocation.disassociate_dead_nodes(state, dead)
 
         self.store.submit(updater)
+        self._schedule_delayed_reroute()
         # reap orphaned child tasks cluster-wide: a dead coordinator can
         # never unblock its shard children, so every surviving node bans
         # the dead node's id prefix (tasks/task_plane.py)
@@ -332,6 +340,101 @@ class ClusterNode:
 
         self.store.submit(updater)
         return {"acknowledged": True}
+
+    def _schedule_delayed_reroute(self) -> None:
+        """Arm a reroute at the delayed-allocation deadline: node-left
+        leaves replica replacements UNASSIGNED-with-deadline, and unless
+        the node bounces back something must wake the allocator when the
+        window closes. The timer only *submits* — the decision itself runs
+        inside the master update queue against the then-current state (and
+        is a no-op when the copies were reclaimed by a rejoin)."""
+        delay_ms = knob("ES_TPU_DELAYED_ALLOC_MS")
+        if delay_ms <= 0:
+            return
+
+        def fire():
+            try:
+                if self.store.is_master(self.node_name):
+                    self.store.submit(
+                        lambda st: self.allocation.reroute(st))
+            except Exception:  # noqa: BLE001 — a stopped store at shutdown
+                pass
+
+        t = threading.Timer(delay_ms / 1000.0 + 0.05, fire)
+        t.daemon = True
+        t.start()
+        self._delayed_timers.append(t)
+
+    def _on_cluster_settings_update(self, req) -> dict:
+        """Dynamic cluster-wide settings (ref: TransportClusterUpdate-
+        SettingsAction): the payload merges into ClusterState.settings
+        (None/"" removes a key) and allocation reruns immediately — so
+        setting cluster.routing.allocation.exclude._name IS the drain."""
+        self._require_master()
+        updates = dict(req.payload.get("settings", {}))
+
+        def updater(state: ClusterState) -> ClusterState:
+            return self.allocation.reroute(state.with_settings(updates))
+
+        self.store.submit(updater)
+        return {"acknowledged": True, "persistent": updates}
+
+    def _on_cluster_reroute(self, req) -> dict:
+        """Explicit reroute commands (ref: TransportClusterRerouteAction).
+        Supports `move`; dry_run plans against the current state and
+        discards, returning per-command explanations either way."""
+        self._require_master()
+        p = req.payload
+        commands = list(p.get("commands", []))
+        dry_run = bool(p.get("dry_run"))
+
+        def plan(state: ClusterState, explain: Optional[list]):
+            st = state
+            for cmd in commands:
+                move = cmd.get("move")
+                if not move:
+                    if explain is not None:
+                        explain.append({
+                            "command": sorted(cmd)[0] if cmd else "?",
+                            "accepted": False,
+                            "reason": "only the move command is supported"})
+                    continue
+                index = move["index"]
+                sid = int(move["shard"])
+                frm, to = move["from_node"], move["to_node"]
+                src = next(
+                    (r for r in st.routing.get(index, [])
+                     if r.shard_id == sid and r.node_id == frm
+                     and r.state == "STARTED"), None)
+                if src is None:
+                    if explain is not None:
+                        explain.append({
+                            "command": "move", "index": index, "shard": sid,
+                            "accepted": False,
+                            "reason": f"no STARTED copy of [{index}][{sid}] "
+                                      f"on [{frm}]"})
+                    continue
+                moved = self.allocation.initiate_relocation(
+                    st, index, sid, src.allocation_id, to)
+                if explain is not None:
+                    explain.append({
+                        "command": "move", "index": index, "shard": sid,
+                        "from_node": frm, "to_node": to,
+                        "accepted": moved is not st,
+                        **({} if moved is not st else
+                           {"reason": "move rejected: target unknown, same "
+                                      "node, or already holds a copy"})})
+                st = moved
+            return st
+
+        explanations: list = []
+        plan(self.state, explanations)
+        if not dry_run:
+            self.store.submit(
+                lambda st: self.allocation.reroute(plan(st, None)))
+        return {"acknowledged": True, "dry_run": dry_run,
+                "explanations": explanations,
+                "state_version": self.state.version}
 
     # ---------------- client surface ----------------
 
@@ -485,8 +588,10 @@ class ClusterNode:
                     break
                 state = self.state
                 primary = state.primary_of(index, sid)
+                # a RELOCATING primary still owns the write path until the
+                # target's shard-started commits the swap
                 if primary is None or primary.node_id is None \
-                        or primary.state != "STARTED":
+                        or not primary.serving:
                     last_err = ElasticsearchTpuError(
                         f"no started primary for [{index}][{sid}]")
                     time.sleep(retry_delay)
@@ -559,7 +664,7 @@ class ClusterNode:
         """Refresh every local + remote copy (broadcast by shard copy)."""
         state = self.state
         nodes = {r.node_id for r in state.routing.get(index, [])
-                 if r.node_id is not None and r.state == "STARTED"}
+                 if r.node_id is not None and r.serving}
         for node in sorted(nodes):
             try:
                 self.channels.request(node, "indices:admin/refresh[shard]",
@@ -567,7 +672,21 @@ class ClusterNode:
             except NodeUnavailableError:
                 pass
 
+    def update_cluster_settings(self, settings: Dict[str, Optional[str]]) -> dict:
+        """Cluster-wide dynamic settings (drain a node by putting its name
+        in cluster.routing.allocation.exclude._name; clear with None)."""
+        return self.master_client("cluster:admin/settings/update",
+                                  {"settings": settings})
+
+    def cluster_reroute(self, commands: List[dict],
+                        dry_run: bool = False) -> dict:
+        """Explicit allocation commands (move), optionally as a dry run."""
+        return self.master_client("cluster:admin/reroute",
+                                  {"commands": commands, "dry_run": dry_run})
+
     def close(self) -> None:
+        for t in self._delayed_timers:
+            t.cancel()
         for key in list(self.shard_service.shards):
             self.shard_service.remove_shard(*key)
         self.transport.close()

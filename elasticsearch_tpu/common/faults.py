@@ -14,8 +14,9 @@ Spec grammar (';'-separated clauses)::
       site   one of KNOWN_SITES: device dispatch sites (turbo_sweep,
              fused_dispatch, merge_kernel, column_upload, blockmax_pass),
              transport RPC sites — query path (rpc_query, rpc_fetch,
-             rpc_can_match) and write path (rpc_bulk, rpc_replica_bulk,
-             rpc_recovery, rpc_resync) — durability sites
+             rpc_can_match), write path (rpc_bulk, rpc_replica_bulk,
+             rpc_recovery, rpc_resync) and maintenance (rpc_relocation,
+             the warm-handoff RPC) — durability sites
              (translog_fsync, translog_corrupt, segment_commit), or the
              pressure site overload_pressure (modes pin a level instead of
              raising: hang -> YELLOW, raise/oom -> RED)
@@ -56,6 +57,7 @@ TRANSPORT_SITES = frozenset({
     "rpc_replica_bulk",  # primary -> replica replication fan-out RPC
     "rpc_recovery",      # target -> source peer-recovery RPCs (all phases)
     "rpc_resync",        # new primary -> replica resync RPCs
+    "rpc_relocation",    # relocation target -> source warm-handoff RPC
 })
 
 # Durable-storage sites (translog / segment commit): failures here must

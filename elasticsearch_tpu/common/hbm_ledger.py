@@ -240,6 +240,20 @@ def note_dispatch(kind: str, shape) -> bool:
     return first
 
 
+def hot_shapes() -> Dict[str, List[int]]:
+    """The integer dispatch shapes this process has traced or primed, per
+    engine kind — the payload a relocation source hands its target so the
+    moved shard's bucket ladder covers the same widths (warm HBM handoff).
+    Non-integer shape keys (e.g. blockmax tuple shapes) are skipped: only
+    QC widths feed extend_qc_sizes."""
+    out: Dict[str, set] = {}
+    with _LOCK:
+        for kind, shape in _SEEN | _PRIMED:
+            if isinstance(shape, (int,)) and not isinstance(shape, bool):
+                out.setdefault(kind, set()).add(int(shape))
+    return {k: sorted(v) for k, v in sorted(out.items())}
+
+
 def note_compile_done(kind: str, shape, wall_s: float) -> None:
     """Record the wall cost of a first-trace dispatch (the compile event)."""
     with _LOCK:

@@ -369,6 +369,18 @@ declare_knob("ES_TPU_RECOVERY_RETRIES", "int", 3,
 declare_knob("ES_TPU_RECOVERY_BACKOFF_MS", "int", 50,
              "Base backoff between peer-recovery retries, ms (doubles per "
              "attempt)")
+# rolling maintenance plane (PR 14)
+declare_knob("ES_TPU_RELOC_WARM", "flag", True,
+             "Warm HBM handoff on shard relocation: the target builds its "
+             "per-field engines, uploads columns, and primes the compile "
+             "cache with the source's hot shapes BEFORE reporting "
+             "shard-started (0 = relocate cold)")
+declare_knob("ES_TPU_DELAYED_ALLOC_MS", "int", 0,
+             "Delayed allocation window after node-left, ms: replica "
+             "replacements stay UNASSIGNED this long so a bounced node "
+             "can rejoin and recover its own copies (0 = reallocate "
+             "immediately; index.unassigned.node_left.delayed_timeout "
+             "analog)")
 # search flight recorder (PR 9)
 declare_knob("ES_TPU_TRACE_SAMPLE", "int", 0,
              "Trace every Nth search even without profile=true or slowlog "

@@ -74,8 +74,21 @@ class IndicesClusterStateService:
                 continue
             inst = self.shards.shards.get((r.index, r.shard_id))
             if inst is None:
-                inst = self.shards.create_shard(meta, r)
-                if r.primary:
+                if (r.state == "INITIALIZING"
+                        and r.relocating_node_id is not None):
+                    # relocation target: even when routing carries the
+                    # primary flag, the source keeps the primary context
+                    # until the swap — this copy recovers as a replica
+                    # (peer recovery from the serving primary), warms its
+                    # HBM/compile caches, then reports started
+                    from dataclasses import replace as _replace
+
+                    inst = self.shards.create_shard(
+                        meta, _replace(r, primary=False))
+                    self._defer_recovery(
+                        inst, relocation_source=r.relocating_node_id)
+                elif r.primary:
+                    inst = self.shards.create_shard(meta, r)
                     # fresh (or locally-recovered) primary: started
                     inst.state = "STARTED" if r.state == "STARTED" \
                         else "INITIALIZING"
@@ -83,11 +96,17 @@ class IndicesClusterStateService:
                         self._defer_report_started(inst)
                         inst.state = "STARTED"
                 else:
+                    inst = self.shards.create_shard(meta, r)
                     self._defer_recovery(inst)
             else:
                 new_term = meta.primary_term(r.shard_id)
-                if r.primary and not inst.primary:
-                    # promotion (ref: IndexShard term bump on new routing)
+                still_reloc_target = (r.state == "INITIALIZING"
+                                      and r.relocating_node_id is not None)
+                if r.primary and not inst.primary and not still_reloc_target:
+                    # promotion (ref: IndexShard term bump on new routing);
+                    # for a relocation swap the term is unchanged — the
+                    # same primary context moves, no bump. A still-
+                    # recovering relocation target must NOT promote yet.
                     self.shards.promote_to_primary(inst, new_term)
                 inst.state = r.state if r.state != "INITIALIZING" \
                     else inst.state
@@ -115,7 +134,8 @@ class IndicesClusterStateService:
 
         self._post_apply.append(report)
 
-    def _defer_recovery(self, inst) -> None:
+    def _defer_recovery(self, inst,
+                        relocation_source: Optional[str] = None) -> None:
         def recover():
             import time
 
@@ -152,6 +172,10 @@ class IndicesClusterStateService:
                      "allocation_id": inst.allocation_id,
                      "reason": f"recovery failed: {last_err}"})
                 return
+            if relocation_source is not None:
+                # warm HBM handoff before shard-started: the moved copy
+                # must not serve its first query cold (best-effort inside)
+                self.shards.warm_relocation_handoff(inst, relocation_source)
             inst.state = "STARTED"
             self.master_client(
                 "internal:cluster/shard/started",

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import base64
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -153,6 +154,9 @@ class DistributedShardService:
                                    self._on_resync_prepare)
         t.register_request_handler("internal:index/shard/resync/apply",
                                    self._on_resync_apply)
+        t.register_request_handler(
+            "internal:index/shard/relocation/warm_info",
+            self._on_relocation_warm_info)
 
     # ---------------- registry ----------------
 
@@ -341,8 +345,11 @@ class DistributedShardService:
         state = self.state
         gcp = inst.tracker.global_checkpoint
         for r in state.shard_copies(inst.index, inst.shard_id):
-            if r.primary or r.node_id is None or r.state == "UNASSIGNED":
+            if r.node_id is None or r.state == "UNASSIGNED":
                 continue
+            # skip SELF by allocation id, not by the primary flag: during a
+            # primary relocation the target carries the primary flag in
+            # routing but must receive every replicated write until the swap
             if r.allocation_id == inst.allocation_id:
                 continue
             in_sync = r.allocation_id in inst.tracker.in_sync_ids
@@ -504,8 +511,10 @@ class DistributedShardService:
         ghost pinning the primary's global checkpoint."""
         state = self.state
         primary = state.primary_of(inst.index, inst.shard_id)
+        # a RELOCATING primary is still the serving copy (and the only
+        # legal recovery source while its own move is in flight)
         if primary is None or primary.node_id is None \
-                or primary.state != "STARTED":
+                or not primary.serving:
             raise ShardNotFoundError(
                 f"no started primary for [{inst.index}][{inst.shard_id}]")
         source = primary.node_id
@@ -595,6 +604,80 @@ class DistributedShardService:
                 inst.engine.delete(op["id"], seq_no=op["seq_no"],
                                    op_primary_term=term)
 
+    # ---------------- relocation: warm HBM handoff ----------------
+
+    class _WarmView:
+        """Minimal index-service view over one shard instance, shaped like
+        the search action's _ShardView so the ServingContext built here is
+        the SAME object the query path reuses after the swap."""
+
+        def __init__(self, inst):
+            self.shards = [inst.engine]
+            self.mapper = inst.mapper
+            self.name = inst.index
+
+    def _on_relocation_warm_info(self, req) -> dict:
+        """Relocation source side: report which fields this copy actually
+        served (the per-field engines its serving snapshot built) and the
+        process's hot dispatch shapes from the compile-cache introspection,
+        so the target can prime before taking traffic."""
+        from elasticsearch_tpu.common import hbm_ledger
+
+        p = req.payload
+        inst = self.get_shard(p["index"], p["shard_id"])
+        fields: List[str] = []
+        ctx = getattr(inst, "_serving_ctx", None)
+        snap = getattr(ctx, "_snapshot", None) if ctx is not None else None
+        if snap is not None:
+            fields = sorted(getattr(snap, "_bm", {}))
+        return {"fields": fields, "shapes": hbm_ledger.hot_shapes()}
+
+    def warm_relocation_handoff(self, inst: ShardInstance,
+                                source_node: str) -> None:
+        """Target side, after recovery and before shard-started: register
+        the engine, upload columns, and prime the compile cache with the
+        source's hot shapes (extend_qc_sizes), so the relocated shard never
+        serves its first query cold. Best-effort — any failure leaves the
+        relocation correct-but-cold (ES_TPU_RELOC_WARM=0 skips it
+        entirely)."""
+        from elasticsearch_tpu.common.relocation import count as _rcount
+        from elasticsearch_tpu.common.settings import knob
+
+        if not knob("ES_TPU_RELOC_WARM"):
+            return
+        t0 = time.monotonic()
+        try:
+            info = self.channels.request(
+                source_node, "internal:index/shard/relocation/warm_info",
+                {"index": inst.index, "shard_id": inst.shard_id})
+            from elasticsearch_tpu.search.serving import ServingContext
+
+            ctx = getattr(inst, "_serving_ctx", None)
+            if ctx is None:
+                ctx = ServingContext(self._WarmView(inst))
+                inst._serving_ctx = ctx
+            snap = ctx.snapshot()
+            sizes = sorted({s for sizes in info["shapes"].values()
+                            for s in sizes})
+            warmed = 0
+            primed = 0
+            for field in info["fields"]:
+                eng = snap.engine(field)
+                if eng is None:
+                    continue
+                warmed += 1
+                if sizes and hasattr(eng, "extend_qc_sizes"):
+                    eng.extend_qc_sizes(sizes)
+                    primed += len(sizes)
+            _rcount("warm_handoffs")
+            _rcount("fields_warmed", warmed)
+            _rcount("shapes_primed", primed)
+        except Exception:  # noqa: BLE001 — warming is best-effort; the
+            _rcount("warm_failures")   # move itself must not fail on it
+        finally:
+            _rcount("warm_ms",
+                    max(0, int((time.monotonic() - t0) * 1000)))
+
     # ---------------- primary promotion + resync ----------------
 
     def promote_to_primary(self, inst: ShardInstance, new_term: int) -> None:
@@ -615,7 +698,9 @@ class DistributedShardService:
         for r in state.shard_copies(inst.index, inst.shard_id):
             if r.allocation_id == inst.allocation_id or r.node_id is None:
                 continue
-            if r.state != "STARTED":
+            # RELOCATING replicas are serving copies and must be resynced
+            # like STARTED ones; INITIALIZING/UNASSIGNED are not yet ours
+            if not r.serving:
                 continue
             try:
                 self._resync_copy(inst, r, gcp, new_term)

@@ -69,8 +69,21 @@ class Node:
         s_buckets = Setting("search.max_buckets", 65536, int, dynamic=True)
         s_auto = Setting("action.auto_create_index", True,
                          lambda v: str(v).lower() != "false", dynamic=True)
+        from elasticsearch_tpu.cluster.allocation import (
+            CONCURRENT_RELOC_SETTING, DEFAULT_CONCURRENT_RELOCATIONS,
+            EXCLUDE_NAME_SETTING,
+        )
+
+        # allocation maintenance settings (PR 14): the drain filter and the
+        # concurrent-relocations cap flow into ClusterState.settings, where
+        # AllocationService's deciders read them (a standalone node has
+        # nowhere to relocate, but the dynamic seam is the same one a
+        # cluster master consumes)
+        s_exclude = Setting(EXCLUDE_NAME_SETTING, "", str, dynamic=True)
+        s_reloc = Setting(CONCURRENT_RELOC_SETTING,
+                          DEFAULT_CONCURRENT_RELOCATIONS, int, dynamic=True)
         self.cluster_settings = ClusterSettings(
-            self.settings, [s_keep, s_buckets, s_auto])
+            self.settings, [s_keep, s_buckets, s_auto, s_exclude, s_reloc])
         self._persistent_settings: dict = {}
         self._transient_settings: dict = {}
         self.auto_create_index = True
@@ -86,6 +99,13 @@ class Node:
             s_keep, lambda v: setattr(self.indices.contexts,
                                       "default_keep_alive_s",
                                       parse_keep_alive(v)))
+        self.cluster_settings.add_settings_update_consumer(
+            s_exclude, lambda v: self.update_state(
+                lambda s: s.with_settings({EXCLUDE_NAME_SETTING: str(v)})))
+        self.cluster_settings.add_settings_update_consumer(
+            s_reloc, lambda v: self.update_state(
+                lambda s: s.with_settings(
+                    {CONCURRENT_RELOC_SETTING: str(int(v))})))
         self.transport = TransportService(self.node_id)
         from elasticsearch_tpu.tasks import TaskManager
 

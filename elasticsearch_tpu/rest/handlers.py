@@ -173,6 +173,7 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/_cluster/health", h.cluster_health)
     r("GET", "/_cluster/state", h.cluster_state)
     r("GET", "/_cluster/stats", h.cluster_stats)
+    r("POST", "/_cluster/reroute", h.cluster_reroute)
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
     r("GET", "/_nodes/hot_threads", h.hot_threads)
@@ -1318,6 +1319,71 @@ class _Handlers:
                     "persistent": _S(self.node._persistent_settings).as_nested_dict(),
                     "transient": _S(self.node._transient_settings).as_nested_dict()})
 
+    def cluster_reroute(self, req: RestRequest) -> RestResponse:
+        """POST /_cluster/reroute (ref: RestClusterRerouteAction) —
+        explicit `move` commands through the same allocation step the
+        drain/rebalance deciders use; `dry_run` plans and discards. On a
+        standalone node every move is explained-and-rejected (there is no
+        second node), which is exactly what the reference answers too."""
+        from elasticsearch_tpu.cluster.allocation import AllocationService
+
+        body = dict(req.body or {})
+        commands = list(body.get("commands", []))
+        dry_run = req.param_bool("dry_run") or bool(body.get("dry_run"))
+        alloc = AllocationService()
+
+        def plan(state, explain):
+            st = state
+            # commands address nodes by id OR name (the reference resolves
+            # both in DiscoveryNodes#resolveNode)
+            by_name = {n.name: nid for nid, n in st.nodes.items()}
+            for cmd in commands:
+                move = cmd.get("move")
+                if not move:
+                    if explain is not None:
+                        explain.append({
+                            "command": sorted(cmd)[0] if cmd else "?",
+                            "accepted": False,
+                            "reason": "only the move command is supported"})
+                    continue
+                index = move["index"]
+                sid = int(move["shard"])
+                frm, to = move["from_node"], move["to_node"]
+                frm = frm if frm in st.nodes else by_name.get(frm, frm)
+                to = to if to in st.nodes else by_name.get(to, to)
+                src = next(
+                    (r for r in st.routing.get(index, [])
+                     if r.shard_id == sid and r.node_id == frm
+                     and r.state == "STARTED"), None)
+                if src is None:
+                    if explain is not None:
+                        explain.append({
+                            "command": "move", "index": index, "shard": sid,
+                            "accepted": False,
+                            "reason": f"no STARTED copy of [{index}][{sid}] "
+                                      f"on [{frm}]"})
+                    continue
+                moved = alloc.initiate_relocation(
+                    st, index, sid, src.allocation_id, to)
+                if explain is not None:
+                    explain.append({
+                        "command": "move", "index": index, "shard": sid,
+                        "from_node": frm, "to_node": to,
+                        "accepted": moved is not st,
+                        **({} if moved is not st else
+                           {"reason": "move rejected: target unknown, same "
+                                      "node, or already holds a copy"})})
+                st = moved
+            return st
+
+        explanations: list = []
+        plan(self.node.cluster_state, explanations)
+        if not dry_run:
+            self.node.update_state(lambda st: alloc.reroute(plan(st, None)))
+        return _ok({"acknowledged": True, "dry_run": dry_run,
+                    "explanations": explanations,
+                    "state": {"version": self.node.cluster_state.version}})
+
     # ---------- rank_eval (ref: modules/rank-eval RankEvalPlugin) ----------
 
     def rank_eval(self, req: RestRequest) -> RestResponse:
@@ -1949,7 +2015,40 @@ class _Handlers:
     # ---------- cluster / monitoring ----------
 
     def cluster_health(self, req: RestRequest) -> RestResponse:
-        return _ok(self.node.cluster_state.health())
+        """GET /_cluster/health — with the maintenance-plane wait params
+        (ref: RestClusterHealthAction): `wait_for_status` blocks until the
+        cluster is at least that healthy, `wait_for_no_relocating_shards`
+        until every move has completed; both are a bounded poll that
+        reports `timed_out: true` rather than erroring on expiry."""
+        from elasticsearch_tpu.tasks.task_manager import parse_timeout_ms
+
+        want_status = req.param("wait_for_status")
+        want_no_reloc = req.param_bool("wait_for_no_relocating_shards")
+        health = self.node.cluster_state.health()
+        if want_status is None and not want_no_reloc:
+            return _ok(health)
+        rank = {"green": 0, "yellow": 1, "red": 2}
+        if want_status is not None and want_status not in rank:
+            raise IllegalArgumentError(
+                f"unknown wait_for_status [{want_status}]")
+        timeout_ms = parse_timeout_ms(req.param("timeout")) or 30_000.0
+        deadline = time.monotonic() + timeout_ms / 1000.0
+
+        def satisfied(h: dict) -> bool:
+            if want_status is not None \
+                    and rank[h["status"]] > rank[want_status]:
+                return False
+            if want_no_reloc and h["relocating_shards"] > 0:
+                return False
+            return True
+
+        while not satisfied(health):
+            if time.monotonic() >= deadline:
+                health["timed_out"] = True
+                return _ok(health)
+            time.sleep(min(0.05, max(0.0, deadline - time.monotonic())))
+            health = self.node.cluster_state.health()
+        return _ok(health)
 
     def cluster_state(self, req: RestRequest) -> RestResponse:
         cs = self.node.cluster_state
@@ -2020,6 +2119,7 @@ class _Handlers:
             "tpu_compile": _tpu_compile_stats(),
             "tpu_tasks": self.node.tasks.stats(),
             "tpu_overload": self.node.overload.stats(),
+            "tpu_relocation": _tpu_relocation_stats(),
             "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
         }
 
@@ -2281,15 +2381,26 @@ class _Handlers:
         return RestResponse(body=line, content_type="text/plain")
 
     def cat_shards(self, req: RestRequest) -> RestResponse:
+        cs = self.node.cluster_state
+
+        def node_name(nid):
+            n = cs.nodes.get(nid)
+            return n.name if n is not None else (nid or "")
+
         rows = []
-        for index, shards in self.node.cluster_state.routing.items():
+        for index, shards in cs.routing.items():
             if not self.node.indices.has(index):
                 continue
             svc = self.node.indices.get(index)
             for s in shards:
                 kind = "p" if s.primary else "r"
                 docs = svc.shards[s.shard_id].doc_count() if s.primary else 0
-                node = self.node.node_name if s.node_id else ""
+                node = node_name(s.node_id) if s.node_id else ""
+                # a moving copy renders `source -> target` (ref: the cat
+                # shards RELOCATING row); its INITIALIZING other half shows
+                # where the bytes are coming from
+                if s.state == "RELOCATING" and s.relocating_node_id:
+                    node = f"{node} -> {node_name(s.relocating_node_id)}"
                 rows.append(f"{index} {s.shard_id} {kind} {s.state} {docs} 0b "
                             f"{'127.0.0.1' if s.node_id else ''} {node}")
         return RestResponse(body="\n".join(rows) + ("\n" if rows else ""),
@@ -2508,6 +2619,15 @@ def _tpu_compile_stats() -> dict:
     from elasticsearch_tpu.common import hbm_ledger
 
     return hbm_ledger.compile_stats()
+
+
+def _tpu_relocation_stats() -> dict:
+    """Maintenance-plane section (PR 14): completed moves, cancelled
+    relocations, and the warm-HBM-handoff accounting (handoffs run, wall
+    ms, fields warmed, qc shapes primed, best-effort failures)."""
+    from elasticsearch_tpu.common.relocation import relocation_stats
+
+    return relocation_stats()
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

@@ -109,7 +109,7 @@ class CrashRestartCluster:
         sid = shard_for_id(doc_id, meta.number_of_shards)
         primary = state.primary_of(index, sid)
         if primary is None or primary.node_id is None \
-                or primary.state != "STARTED":
+                or not primary.serving:
             return None
         holder = self.by_name.get(primary.node_id)
         if holder is None:

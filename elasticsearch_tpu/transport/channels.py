@@ -57,6 +57,9 @@ _RPC_FAULT_SITES = {
     "internal:index/shard/recovery/cancel": "rpc_recovery",
     "internal:index/shard/resync/prepare": "rpc_resync",
     "internal:index/shard/resync/apply": "rpc_resync",
+    # relocation warm handoff (the recovery RPCs a relocating target runs
+    # keep their rpc_recovery site — reuse #node selectors for those)
+    "internal:index/shard/relocation/warm_info": "rpc_relocation",
 }
 
 

@@ -1,0 +1,630 @@
+"""Rolling maintenance plane (PR 14): live shard relocation with warm HBM
+handoff, node drain, delayed allocation, rebalancing.
+
+Three layers, mirroring the plane's own structure:
+
+* pure state-transition tests over AllocationService — the relocation
+  state machine (initiate/complete/cancel), the drain + rebalance
+  deciders, the concurrent-relocations cap, and delayed allocation with
+  a FAKE clock (the timer merely submits; the decision is a pure
+  function of state + now_ms);
+* live in-process cluster tests — a real move over the transport (peer
+  recovery + in-sync swap + warm handoff), drain via
+  PUT /_cluster/settings, delayed allocation around a crash/restart
+  bounce, and the rpc_relocation fault site;
+* the chaos lane's rolling-restart scenario: drain -> relocations
+  complete -> crash -> restart -> rejoin -> rebalance, with zero acked
+  writes lost and admitted searches agreeing before and after.
+"""
+
+import time as _time
+
+import pytest
+
+from elasticsearch_tpu.cluster.allocation import (
+    AllocationService, CONCURRENT_RELOC_SETTING, EXCLUDE_NAME_SETTING,
+)
+from elasticsearch_tpu.cluster.state import (
+    ClusterState, DiscoveryNode, IndexMetadata, ShardRouting,
+)
+from elasticsearch_tpu.cluster_node import form_local_cluster
+from elasticsearch_tpu.common import relocation as reloc_counters
+from elasticsearch_tpu.common.settings import Settings
+
+MAPPINGS = {"properties": {"n": {"type": "integer"},
+                           "body": {"type": "text"}}}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_counters():
+    reloc_counters.reset_for_tests()
+    yield
+    reloc_counters.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# pure state transitions
+# ---------------------------------------------------------------------------
+
+def make_state(n_nodes=3, shards=1, replicas=0, placements=None):
+    """A hand-built state: nodes n0..nK, one index, explicit placements
+    {(shard_id, primary): node} (default: round-robin STARTED copies)."""
+    nodes = {f"n{i}": DiscoveryNode(node_id=f"n{i}", name=f"n{i}")
+             for i in range(n_nodes)}
+    routing = []
+    in_sync = {}
+    aid = [0]
+
+    def new_aid():
+        aid[0] += 1
+        return f"aid{aid[0]:03d}"
+
+    for sid in range(shards):
+        copies = [(sid, True)] + [(sid, False)] * replicas
+        for j, (s, primary) in enumerate(copies):
+            if placements is not None:
+                node = placements.get((s, primary))
+            else:
+                node = f"n{(s + j) % n_nodes}"
+            a = new_aid()
+            routing.append(ShardRouting(
+                index="idx", shard_id=s, node_id=node, primary=primary,
+                state="STARTED", allocation_id=a))
+            in_sync.setdefault(s, []).append(a)
+    meta = IndexMetadata(
+        index="idx", uuid="u1",
+        settings=Settings({"index.number_of_shards": shards,
+                           "index.number_of_replicas": replicas}),
+        mappings=MAPPINGS,
+        primary_terms=tuple([1] * shards),
+        in_sync_allocations={s: tuple(v) for s, v in in_sync.items()})
+    return ClusterState(master_node_id="n0", nodes=nodes,
+                        indices={"idx": meta}, routing={"idx": routing})
+
+
+def copies(state, sid=0):
+    return state.shard_copies("idx", sid)
+
+
+def by_state(state, want, sid=0):
+    return [r for r in copies(state, sid) if r.state == want]
+
+
+def test_initiate_relocation_creates_linked_pair():
+    alloc = AllocationService()
+    st = make_state(n_nodes=2, placements={(0, True): "n0"})
+    src = copies(st)[0]
+    out = alloc.initiate_relocation(st, "idx", 0, src.allocation_id, "n1")
+    assert out is not st
+    (source,) = by_state(out, "RELOCATING")
+    (target,) = by_state(out, "INITIALIZING")
+    assert source.node_id == "n0" and source.relocating_node_id == "n1"
+    assert target.node_id == "n1" and target.relocating_node_id == "n0"
+    assert target.primary == source.primary
+    assert target.allocation_id not in ("", source.allocation_id)
+    # the source keeps serving mid-move
+    assert source.serving and not target.serving
+    assert out.primary_of("idx", 0) is source
+    # in-sync is untouched until the target actually starts
+    assert out.indices["idx"].in_sync_allocations[0] \
+        == st.indices["idx"].in_sync_allocations[0]
+
+
+def test_initiate_relocation_rejects_illegal_moves():
+    alloc = AllocationService()
+    st = make_state(n_nodes=2, replicas=1,
+                    placements={(0, True): "n0", (0, False): "n1"})
+    src = st.primary_of("idx", 0)
+    # same-shard rule: n1 already holds a copy
+    assert alloc.initiate_relocation(
+        st, "idx", 0, src.allocation_id, "n1") is st
+    # unknown target node
+    assert alloc.initiate_relocation(
+        st, "idx", 0, src.allocation_id, "n9") is st
+    # source == target
+    assert alloc.initiate_relocation(
+        st, "idx", 0, src.allocation_id, "n0") is st
+
+
+def test_relocation_complete_swaps_in_sync_and_removes_source():
+    alloc = AllocationService()
+    st = make_state(n_nodes=2, placements={(0, True): "n0"})
+    src = copies(st)[0]
+    st = alloc.initiate_relocation(st, "idx", 0, src.allocation_id, "n1")
+    (target,) = by_state(st, "INITIALIZING")
+    out = alloc.apply_started_shard(st, "idx", 0, target.allocation_id)
+    assert len(copies(out)) == 1
+    (started,) = copies(out)
+    assert started.node_id == "n1" and started.state == "STARTED"
+    assert started.primary and started.relocating_node_id is None
+    in_sync = set(out.indices["idx"].in_sync_allocations[0])
+    assert in_sync == {target.allocation_id}
+    assert src.allocation_id not in in_sync
+    # same primary context moved: NO term bump on a relocation swap
+    assert out.indices["idx"].primary_term(0) == 1
+    assert reloc_counters.relocation_stats()["moves"] == 1
+    h = out.health()
+    assert h["status"] == "green" and h["relocating_shards"] == 0
+
+
+def test_relocation_target_failure_cancels_cleanly():
+    alloc = AllocationService()
+    st = make_state(n_nodes=2, placements={(0, True): "n0"})
+    src = copies(st)[0]
+    st = alloc.initiate_relocation(st, "idx", 0, src.allocation_id, "n1")
+    (target,) = by_state(st, "INITIALIZING")
+    out = alloc.apply_failed_shard(st, "idx", 0, target.allocation_id)
+    (back,) = copies(out)
+    assert back.state == "STARTED" and back.node_id == "n0"
+    assert back.relocating_node_id is None
+    assert back.allocation_id == src.allocation_id
+    # no replacement UNASSIGNED copy appears: nothing was lost
+    assert not by_state(out, "UNASSIGNED")
+    assert set(out.indices["idx"].in_sync_allocations[0]) \
+        == {src.allocation_id}
+    assert reloc_counters.relocation_stats()["cancels"] == 1
+
+
+def test_dead_target_node_reverts_source():
+    alloc = AllocationService()
+    st = make_state(n_nodes=2, placements={(0, True): "n0"})
+    src = copies(st)[0]
+    st = alloc.initiate_relocation(st, "idx", 0, src.allocation_id, "n1")
+    out = alloc.disassociate_dead_nodes(st, {"n1"}, delayed_ms=0)
+    (back,) = copies(out)
+    assert back.state == "STARTED" and back.node_id == "n0"
+    assert reloc_counters.relocation_stats()["cancels"] == 1
+    assert out.health()["status"] == "green"
+
+
+def test_dead_source_node_promotes_and_drops_target():
+    """Killing the source mid-transfer takes the half-built target with it;
+    an in-sync replica is promoted so the shard stays served."""
+    alloc = AllocationService()
+    st = make_state(n_nodes=3, replicas=1,
+                    placements={(0, True): "n0", (0, False): "n1"})
+    src = st.primary_of("idx", 0)
+    replica = next(r for r in copies(st) if not r.primary)
+    st = alloc.initiate_relocation(st, "idx", 0, src.allocation_id, "n2")
+    (target,) = by_state(st, "INITIALIZING")
+    out = alloc.disassociate_dead_nodes(st, {"n0"}, delayed_ms=0)
+    promoted = out.primary_of("idx", 0)
+    assert promoted is not None and promoted.node_id == "n1"
+    assert promoted.allocation_id == replica.allocation_id
+    assert out.indices["idx"].primary_term(0) == 2  # real failover: bump
+    in_sync = set(out.indices["idx"].in_sync_allocations[0])
+    assert target.allocation_id not in in_sync
+    alive_nodes = {r.node_id for r in copies(out)}
+    assert "n0" not in alive_nodes
+    # the orphaned target is gone too (it could never finish recovering)
+    assert all(r.relocating_node_id is None for r in copies(out))
+
+
+def test_drain_via_exclude_setting_bounded_by_cap():
+    alloc = AllocationService()
+    st = make_state(n_nodes=3, shards=4, placements={
+        (0, True): "n0", (1, True): "n0", (2, True): "n0", (3, True): "n1"})
+    st = st.with_settings({EXCLUDE_NAME_SETTING: "n0",
+                           CONCURRENT_RELOC_SETTING: "2"})
+    out = alloc.reroute(st)
+    moving = [r for shards in out.routing.values() for r in shards
+              if r.state == "RELOCATING"]
+    assert len(moving) == 2          # cap, not all three at once
+    assert all(r.node_id == "n0" for r in moving)
+    assert all(r.relocating_node_id != "n0" for r in moving)
+    # completing one move frees budget for the next drain step
+    tgt = next(r for r in by_state(out, "INITIALIZING",
+                                   sid=moving[0].shard_id))
+    out2 = alloc.reroute(alloc.apply_started_shard(
+        out, "idx", moving[0].shard_id, tgt.allocation_id))
+    moving2 = [r for shards in out2.routing.values() for r in shards
+               if r.state == "RELOCATING"]
+    assert len(moving2) == 2
+
+
+def test_drain_respects_same_shard_rule():
+    """A drained primary whose only other nodes hold the replica stays put
+    rather than doubling up."""
+    alloc = AllocationService()
+    st = make_state(n_nodes=2, replicas=1,
+                    placements={(0, True): "n0", (0, False): "n1"})
+    st = st.with_settings({EXCLUDE_NAME_SETTING: "n0"})
+    out = alloc.reroute(st)
+    assert not by_state(out, "RELOCATING")
+    assert out.primary_of("idx", 0).node_id == "n0"
+
+
+def test_rebalance_moves_onto_new_node():
+    alloc = AllocationService()
+    st = make_state(n_nodes=2, shards=4, placements={
+        (0, True): "n0", (1, True): "n0", (2, True): "n1", (3, True): "n1"})
+    st = st.with_node(DiscoveryNode(node_id="n2", name="n2"))
+    out = alloc.reroute(st)
+    moving = [r for shards in out.routing.values() for r in shards
+              if r.state == "RELOCATING"]
+    assert moving, "an empty joiner must attract copies"
+    targets = [r.relocating_node_id for r in moving]
+    assert all(t == "n2" for t in targets)
+    # spread >= 2 rule: a 2-vs-1 split does not thrash
+    for r in moving:
+        tgt = next(t for t in by_state(out, "INITIALIZING", sid=r.shard_id))
+        out = alloc.apply_started_shard(out, "idx", r.shard_id,
+                                        tgt.allocation_id)
+    settled = alloc.reroute(out)
+    still = [r for shards in settled.routing.values() for r in shards
+             if r.state == "RELOCATING"]
+    assert not still
+
+
+def test_delayed_allocation_fake_clock_window_then_expiry():
+    clock = [1_000_000]
+    alloc = AllocationService(clock=lambda: clock[0])
+    st = make_state(n_nodes=3, replicas=1,
+                    placements={(0, True): "n0", (0, False): "n1"})
+    out = alloc.disassociate_dead_nodes(st, {"n1"}, delayed_ms=30_000)
+    (repl,) = by_state(out, "UNASSIGNED")
+    assert repl.delayed_until_ms == 1_030_000
+    assert repl.last_node_id == "n1"
+    h = out.health(now_ms=clock[0])
+    assert h["delayed_unassigned_shards"] == 1
+    assert h["status"] == "yellow"
+    # inside the window: reroute must NOT build a replacement elsewhere
+    inside = alloc.reroute(out, now_ms=1_010_000)
+    assert by_state(inside, "UNASSIGNED")
+    assert not by_state(inside, "INITIALIZING")
+    # past the deadline: the replacement allocates (exactly once)
+    clock[0] = 1_030_001
+    expired = alloc.reroute(out)
+    (init,) = by_state(expired, "INITIALIZING")
+    assert init.node_id == "n2"   # n0 holds the primary; same-shard rule
+    assert init.delayed_until_ms is None
+    assert expired.health(now_ms=clock[0])["delayed_unassigned_shards"] == 0
+
+
+def test_delayed_allocation_rejoin_reclaims_own_copy():
+    clock = [500_000]
+    alloc = AllocationService(clock=lambda: clock[0])
+    st = make_state(n_nodes=3, replicas=1,
+                    placements={(0, True): "n0", (0, False): "n1"})
+    out = alloc.disassociate_dead_nodes(st, {"n1"}, delayed_ms=60_000)
+    # the node bounces back inside the window
+    back = out.with_node(DiscoveryNode(node_id="n1", name="n1"))
+    rejoined = alloc.reroute(back, now_ms=510_000)
+    (init,) = by_state(rejoined, "INITIALIZING")
+    assert init.node_id == "n1"   # its own copy, not a stranger's
+
+
+# ---------------------------------------------------------------------------
+# live in-process cluster
+# ---------------------------------------------------------------------------
+
+def make_cluster(n_data=3, data_path=None):
+    names = ["m0"] + [f"d{i}" for i in range(n_data)]
+    roles = {"m0": ("master",)}
+    return form_local_cluster(names, data_path=data_path, roles=roles)
+
+
+def index_body(shards=1, replicas=0):
+    return {"settings": {"number_of_shards": shards,
+                         "number_of_replicas": replicas},
+            "mappings": MAPPINGS}
+
+
+def bulk_ops(start, count):
+    return [{"op": "index", "id": str(i),
+             "source": {"n": i, "body": f"word{i % 7} common text"}}
+            for i in range(start, start + count)]
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        if pred():
+            return True
+        _time.sleep(0.02)
+    return pred()
+
+
+def nodes_holding(store, index, sid):
+    return {r.node_id for r in store.current().shard_copies(index, sid)
+            if r.node_id is not None}
+
+
+def test_live_move_command_relocates_and_preserves_results():
+    nodes, store, channels = make_cluster()
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(1, 0))
+    a.bulk("docs", bulk_ops(0, 40))
+    a.refresh("docs")
+    before = b.search("docs", {"query": {"match": {"body": "common"}},
+                               "size": 10, "track_total_hits": True})
+    src = store.current().primary_of("docs", 0).node_id
+    free = next(n for n in ("d0", "d1", "d2") if n != src)
+    resp = a.cluster_reroute([{"move": {
+        "index": "docs", "shard": 0, "from_node": src, "to_node": free}}])
+    assert resp["acknowledged"]
+    assert wait_until(lambda: nodes_holding(store, "docs", 0) == {free})
+    assert wait_until(
+        lambda: store.current().health()["relocating_shards"] == 0)
+    h = store.current().health()
+    assert h["status"] == "green"
+    after = c.search("docs", {"query": {"match": {"body": "common"}},
+                              "size": 10, "track_total_hits": True})
+    assert after["hits"]["total"]["value"] \
+        == before["hits"]["total"]["value"] == 40
+    assert [x["_id"] for x in after["hits"]["hits"]] \
+        == [x["_id"] for x in before["hits"]["hits"]]
+    assert reloc_counters.relocation_stats()["moves"] == 1
+    # writes keep flowing through the moved primary
+    r2 = a.bulk("docs", bulk_ops(40, 10))
+    assert not r2["errors"]
+
+
+def test_live_move_dry_run_changes_nothing():
+    nodes, store, channels = make_cluster()
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(1, 0))
+    src = store.current().primary_of("docs", 0).node_id
+    free = next(n for n in ("d0", "d1", "d2") if n != src)
+    v0 = store.current().version
+    resp = a.cluster_reroute(
+        [{"move": {"index": "docs", "shard": 0,
+                   "from_node": src, "to_node": free}},
+         {"cancel": {}}], dry_run=True)
+    assert resp["dry_run"]
+    assert resp["explanations"][0]["accepted"] is True
+    assert resp["explanations"][1]["accepted"] is False
+    assert store.current().version == v0
+    assert nodes_holding(store, "docs", 0) == {src}
+
+
+def test_live_drain_then_rebalance_on_clear(tmp_path):
+    nodes, store, channels = make_cluster(data_path=str(tmp_path))
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(2, 1))
+    a.bulk("docs", bulk_ops(0, 30))
+    a.refresh("docs")
+    # drain d0: every copy must leave, bounded by the cap under the hood
+    a.update_cluster_settings({EXCLUDE_NAME_SETTING: "d0"})
+    assert wait_until(lambda: not store.current().entries_on_node("d0"))
+    assert wait_until(
+        lambda: store.current().health()["relocating_shards"] == 0)
+    assert store.current().health()["status"] == "green"
+    r = b.search("docs", {"query": {"match": {"body": "common"}},
+                          "size": 5, "track_total_hits": True})
+    assert r["hits"]["total"]["value"] == 30
+    # clearing the filter lets the rebalancer repopulate the empty node
+    a.update_cluster_settings({EXCLUDE_NAME_SETTING: None})
+    assert wait_until(lambda: bool(store.current().entries_on_node("d0")))
+    assert wait_until(
+        lambda: store.current().health()["relocating_shards"] == 0)
+    assert store.current().health()["status"] == "green"
+
+
+def test_warm_handoff_primes_target(monkeypatch):
+    """ES_TPU_RELOC_WARM=1 (default): the moved copy's per-field engines
+    and qc bucket ladder are primed BEFORE shard-started, measured by the
+    tpu_relocation counters; =0 leaves the move correct but cold."""
+    monkeypatch.setenv("ES_TPU_FORCE_TURBO", "1")
+    from elasticsearch_tpu.common import hbm_ledger
+    nodes, store, channels = make_cluster()
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(1, 0))
+    a.bulk("docs", bulk_ops(0, 60))
+    a.refresh("docs")
+    # serve queries so the source builds its per-field engine and the
+    # ledger records hot dispatch shapes (what the handoff transfers)
+    for _ in range(2):
+        b.search("docs", {"query": {"match": {"body": "common"}}, "size": 5})
+    assert hbm_ledger.hot_shapes(), "searches must leave hot shapes behind"
+    src = store.current().primary_of("docs", 0).node_id
+    free = next(n for n in ("d0", "d1", "d2") if n != src)
+
+    monkeypatch.setenv("ES_TPU_RELOC_WARM", "0")
+    a.cluster_reroute([{"move": {"index": "docs", "shard": 0,
+                                 "from_node": src, "to_node": free}}])
+    assert wait_until(lambda: nodes_holding(store, "docs", 0) == {free})
+    cold = reloc_counters.relocation_stats()
+    assert cold["moves"] == 1 and cold["warm_handoffs"] == 0
+    assert cold["shapes_primed"] == 0
+    # kill switch off -> the move is correct anyway
+    r = c.search("docs", {"query": {"match": {"body": "common"}},
+                          "size": 5, "track_total_hits": True})
+    assert r["hits"]["total"]["value"] == 60
+
+    monkeypatch.setenv("ES_TPU_RELOC_WARM", "1")
+    b.search("docs", {"query": {"match": {"body": "common"}}, "size": 5})
+    src2, free2 = free, src
+    a.cluster_reroute([{"move": {"index": "docs", "shard": 0,
+                                 "from_node": src2, "to_node": free2}}])
+    assert wait_until(lambda: nodes_holding(store, "docs", 0) == {free2})
+    warm = reloc_counters.relocation_stats()
+    assert warm["moves"] == 2
+    assert warm["warm_handoffs"] == 1
+    assert warm["fields_warmed"] >= 1      # the body engine was pre-built
+    assert warm["shapes_primed"] > 0       # qc ladder covered hot widths
+    assert warm["warm_failures"] == 0
+    retraces_before = hbm_ledger.compile_stats()["retraces"]
+    r = c.search("docs", {"query": {"match": {"body": "common"}},
+                          "size": 5, "track_total_hits": True})
+    assert r["hits"]["total"]["value"] == 60
+    # first post-move query dispatches at a primed shape: no new retrace
+    assert hbm_ledger.compile_stats()["retraces"] == retraces_before
+
+
+def test_rpc_relocation_fault_leaves_move_correct_but_cold():
+    """Faulting the warm-info RPC (site rpc_relocation, #node selector
+    reused from rpc_recovery) must not fail the move — warming is
+    best-effort, and the failure is counted."""
+    from elasticsearch_tpu.common import faults
+    nodes, store, channels = make_cluster()
+    master, a, b, c = nodes
+    a.create_index("docs", index_body(1, 0))
+    a.bulk("docs", bulk_ops(0, 20))
+    a.refresh("docs")
+    b.search("docs", {"query": {"match": {"body": "common"}}, "size": 5})
+    src = store.current().primary_of("docs", 0).node_id
+    free = next(n for n in ("d0", "d1", "d2") if n != src)
+    with faults.inject(f"rpc_relocation#{src}:raise"):
+        a.cluster_reroute([{"move": {"index": "docs", "shard": 0,
+                                     "from_node": src, "to_node": free}}])
+        assert wait_until(lambda: nodes_holding(store, "docs", 0) == {free})
+    stats = reloc_counters.relocation_stats()
+    assert stats["moves"] == 1
+    assert stats["warm_failures"] == 1
+    assert stats["warm_handoffs"] == 0
+    assert store.current().health()["status"] == "green"
+    r = c.search("docs", {"query": {"match": {"body": "common"}},
+                          "size": 5, "track_total_hits": True})
+    assert r["hits"]["total"]["value"] == 20
+
+
+def test_live_delayed_allocation_bounce_inside_window(tmp_path, monkeypatch):
+    """A node bouncing inside ES_TPU_DELAYED_ALLOC_MS rejoins and recovers
+    its own copies: zero replacement copies are built elsewhere, and the
+    wait shows up in delayed_unassigned_shards."""
+    from elasticsearch_tpu.testing.chaos import CrashRestartCluster
+    monkeypatch.setenv("ES_TPU_DELAYED_ALLOC_MS", "60000")
+    cluster = CrashRestartCluster(
+        ["m0", "d0", "d1"], str(tmp_path), roles={"m0": ("master",)})
+    m = cluster.node("m0")
+    m.create_index("docs", index_body(1, 1))
+    cluster.node("d0").bulk("docs", bulk_ops(0, 25))
+    m2 = cluster.master()
+    replica = next(r for r in cluster.store.current().shard_copies("docs", 0)
+                   if not r.primary)
+    victim = replica.node_id
+    cluster.crash(victim, report=True)
+    st = cluster.store.current()
+    h = st.health()
+    assert h["delayed_unassigned_shards"] == 1
+    assert h["status"] == "yellow"
+    (unassigned,) = [r for r in st.shard_copies("docs", 0)
+                     if r.state == "UNASSIGNED"]
+    assert unassigned.last_node_id == victim
+    # no replacement sprouted on the surviving data node
+    survivor = "d0" if victim == "d1" else "d1"
+    assert len([r for r in st.shard_copies("docs", 0)
+                if r.node_id == survivor]) <= 1
+    cluster.restart(victim)
+    assert wait_until(
+        lambda: cluster.store.current().health()["status"] == "green")
+    st = cluster.store.current()
+    (back,) = [r for r in st.shard_copies("docs", 0)
+               if r.node_id == victim]
+    assert back.state == "STARTED"
+    assert st.health()["delayed_unassigned_shards"] == 0
+
+
+def test_live_delayed_allocation_expiry_allocates_exactly_once(
+        tmp_path, monkeypatch):
+    from elasticsearch_tpu.testing.chaos import CrashRestartCluster
+    monkeypatch.setenv("ES_TPU_DELAYED_ALLOC_MS", "150")
+    cluster = CrashRestartCluster(
+        ["m0", "d0", "d1", "d2"], str(tmp_path), roles={"m0": ("master",)})
+    m = cluster.node("m0")
+    m.create_index("docs", index_body(1, 1))
+    cluster.node("d0").bulk("docs", bulk_ops(0, 10))
+    replica = next(r for r in cluster.store.current().shard_copies("docs", 0)
+                   if not r.primary)
+    victim = replica.node_id
+    cluster.crash(victim, report=True)
+    assert cluster.store.current().health()["delayed_unassigned_shards"] == 1
+    # the master's timer fires after the window and reroutes: the
+    # replacement builds on a remaining node, exactly once
+    assert wait_until(
+        lambda: cluster.store.current().health()["status"] == "green",
+        timeout=8.0)
+    st = cluster.store.current()
+    cps = st.shard_copies("docs", 0)
+    assert len(cps) == 2
+    assert {r.state for r in cps} == {"STARTED"}
+    assert victim not in {r.node_id for r in cps}
+
+
+# ---------------------------------------------------------------------------
+# chaos lane: the rolling-restart scenario
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_rolling_restart_drain_crash_rejoin_rebalance(tmp_path, monkeypatch):
+    """The maintenance window end-to-end: drain d0 -> every copy moves off
+    -> crash d0 (as a reboot would) -> restart + rejoin -> clear the
+    filter -> rebalance repopulates it. No acked write is lost (checked
+    via the linearizability harness), admitted searches agree bit-for-bit
+    before and after, and the cluster ends green with zero relocating
+    shards."""
+    from elasticsearch_tpu.testing.chaos import (
+        AckedWriteHistory, CrashRestartCluster,
+    )
+    monkeypatch.setenv("ES_TPU_DELAYED_ALLOC_MS", "0")
+    cluster = CrashRestartCluster(
+        ["m0", "d0", "d1", "d2"], str(tmp_path), roles={"m0": ("master",)})
+    m = cluster.node("m0")
+    m.create_index("docs", index_body(2, 1))
+    history = AckedWriteHistory()
+
+    def write(doc_id, n, via="d1"):
+        # the register value is the scalar n (the checker's state must be
+        # hashable); the documents carry the full source
+        op = history.invoke(doc_id, "write", n)
+        try:
+            r = cluster.node(via).bulk(
+                "docs", [{"op": "index", "id": doc_id,
+                          "source": {"n": n,
+                                     "body": f"word{n % 7} common text"}}],
+                retries=3)
+            if not r["errors"]:
+                history.respond(doc_id, op)
+        except Exception:  # noqa: BLE001 — unacked: either outcome legal
+            pass
+
+    for i in range(30):
+        write(str(i), i)
+    cluster.node("d1").refresh("docs")
+    before = cluster.node("d1").search(
+        "docs", {"query": {"match": {"body": "common"}},
+                 "size": 10, "track_total_hits": True,
+                 "sort": [{"n": "asc"}]})
+
+    # 1. drain: exclude d0, wait for zero copies + no relocations
+    cluster.master().update_cluster_settings({EXCLUDE_NAME_SETTING: "d0"})
+    assert wait_until(
+        lambda: not cluster.store.current().entries_on_node("d0"))
+    assert wait_until(
+        lambda: cluster.store.current().health()["relocating_shards"] == 0)
+    assert cluster.store.current().health()["status"] == "green"
+    for i in range(30, 45):
+        write(str(i), i)
+
+    # 2. the maintenance reboot: crash, then restart from the same path
+    cluster.crash("d0", report=True)
+    assert cluster.store.current().health()["status"] == "green"
+    for i in range(45, 60):
+        write(str(i), i)
+    cluster.restart("d0")
+
+    # 3. clear the filter: the rebalancer repopulates the rejoined node
+    cluster.master().update_cluster_settings({EXCLUDE_NAME_SETTING: None})
+    assert wait_until(
+        lambda: bool(cluster.store.current().entries_on_node("d0")))
+    assert wait_until(
+        lambda: cluster.store.current().health()["relocating_shards"] == 0)
+    h = cluster.store.current().health()
+    assert h["status"] == "green"
+    assert h["relocating_shards"] == 0
+
+    # durability: every acked write is readable through the final primaries
+    for i in range(60):
+        source = cluster.read_doc("docs", str(i))
+        history.record_read(str(i), None if source is None else source["n"])
+    assert history.check() == []
+    # admitted searches agree bit-for-bit with the pre-maintenance answer
+    cluster.node("d1").refresh("docs")
+    after = cluster.node("d1").search(
+        "docs", {"query": {"match": {"body": "common"}},
+                 "size": 10, "track_total_hits": True,
+                 "sort": [{"n": "asc"}]})
+    assert [(x["_id"], x["sort"]) for x in after["hits"]["hits"]] \
+        == [(x["_id"], x["sort"]) for x in before["hits"]["hits"]]
+    assert reloc_counters.relocation_stats()["moves"] >= 3
