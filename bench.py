@@ -1673,6 +1673,165 @@ def dryrun_relocation() -> int:
     return 0 if ok else 1
 
 
+def dryrun_integrity() -> int:
+    """Integrity smoke (PR 15): inject segment_read corruption under
+    concurrent search traffic on the crash-restart cluster (the corrupted
+    primary copy is refused, the replica serves — ZERO corrupt results
+    reach a caller), then inject hbm_region corruption against a live
+    TurboBM25 and assert the scrubber detects + repairs it with post-repair
+    results bit-identical to the pre-corruption baseline. Repair counters
+    must reconcile (every mismatch repaired, every corrupt copy failed).
+    One JSON line on stdout; exit 0/1."""
+    import tempfile
+    import threading
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.common import faults, integrity
+    from elasticsearch_tpu.testing.chaos import CrashRestartCluster
+
+    integrity.reset_for_tests()
+    integrity.reset_scrub_for_tests()
+
+    # ---- leg 1: at-rest corruption under concurrent search/bulk ----
+    log("dryrun_integrity: forming crash-restart cluster...")
+    corrupt_served = [0]
+    search_errors = [0]
+    searches = [0]
+    bulks = [0]
+    with tempfile.TemporaryDirectory() as tmp:
+        cluster = CrashRestartCluster(["m0", "d0", "d1", "d2"], tmp,
+                                      roles={"m0": ("master",)})
+        master = cluster.master()
+        master.create_index("docs", {
+            "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+            "mappings": {"properties": {"n": {"type": "integer"},
+                                        "body": {"type": "text"}}}})
+        expected = {str(i): i for i in range(40)}
+        master.bulk("docs", [
+            {"op": "index", "id": d,
+             "source": {"n": v, "body": f"orig word{v % 7}"}}
+            for d, v in expected.items()])
+        master.refresh("docs")
+        victim = None
+        for r in cluster.store.current().shard_copies("docs", 0):
+            if r.primary and r.state == "STARTED":
+                victim = r.node_id
+        cluster.primary_instance("docs", "0").engine.flush()
+
+        stop = threading.Event()
+
+        def searcher():
+            # immutable originals only: any hit whose stored value differs
+            # from what was written IS a corrupt result served
+            body = {"query": {"match": {"body": "orig"}}, "size": 50}
+            while not stop.is_set():
+                try:
+                    resp = master.search("docs", body)
+                    searches[0] += 1
+                    for hit in resp["hits"]["hits"]:
+                        if expected.get(hit["_id"]) != hit["_source"]["n"]:
+                            corrupt_served[0] += 1
+                except Exception:   # noqa: BLE001 — shed/unavailable is
+                    search_errors[0] += 1   # fine; corrupt data is not
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                try:
+                    master.bulk("docs", [
+                        {"op": "index", "id": f"w{i}",
+                         "source": {"n": i, "body": "extra"}}])
+                    bulks[0] += 1
+                except Exception:   # noqa: BLE001
+                    pass
+                i += 1
+
+        threads = [threading.Thread(target=searcher),
+                   threading.Thread(target=searcher),
+                   threading.Thread(target=writer)]
+        for t in threads:
+            t.start()
+        try:
+            # fast restart: the master never saw the crash; the checksum
+            # footer (not failure detection) must refuse the rotted copy
+            cluster.crash(victim, report=False)
+            with faults.inject("segment_read:raise@1x1"):
+                cluster.restart(victim)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        survivors_ok = all(
+            (cluster.read_doc("docs", d) or {}).get("n") == v
+            for d, v in expected.items())
+        for n in list(cluster.by_name.values()):
+            n.close()
+    st1 = dict(integrity.integrity_stats())
+
+    # ---- leg 2: HBM corruption detected + repaired by the scrubber ----
+    log("dryrun_integrity: HBM scrub leg...")
+    from elasticsearch_tpu.index.segment import build_field_postings
+    from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+    from elasticsearch_tpu.parallel.turbo import TurboBM25
+
+    rng = np.random.default_rng(17)
+    n_docs, vocab = 1200, 60
+    probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+    probs /= probs.sum()
+    lens = rng.integers(4, 20, size=n_docs).astype(np.int64)
+    tokens = rng.choice(vocab, size=int(lens.sum()),
+                        p=probs).astype(np.int64)
+    fp = build_field_postings(
+        "body", lens, np.repeat(np.arange(n_docs, dtype=np.int64), lens),
+        tokens, [f"t{i}" for i in range(vocab)])
+    stacked = build_stacked_bm25([_Seg(n_docs, fp)], "body",
+                                 serve_only=True)
+    turbo = TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=5)
+    queries = [[("t1", 1.0), ("t3", 1.0)], [("t2", 2.0)],
+               [("t4", 1.0), ("t7", 1.0)]]
+    base_s, base_d = turbo.search(queries, k=10)
+    with faults.inject("hbm_region:raise@1x1"):
+        for _ in range(integrity.scrub_registry_size()):
+            integrity.scrub_once()
+    got_s, got_d = turbo.search(queries, k=10)
+    identical = (np.array_equal(np.asarray(base_d), np.asarray(got_d))
+                 and np.array_equal(np.asarray(base_s), np.asarray(got_s)))
+    st2 = integrity.integrity_stats()
+
+    reconciled = (st2["scrub_mismatches"] == st2["scrub_repairs"] >= 1
+                  and st1["segments_corrupted"] >= 1
+                  and st1["shards_failed_corrupt"] >= 1
+                  and st1["markers_written"] >= 1)
+    ok = (corrupt_served[0] == 0 and survivors_ok and identical
+          and reconciled and searches[0] > 0 and bulks[0] > 0)
+    print(json.dumps({
+        "metric": "dryrun_integrity",
+        "ok": bool(ok),
+        "corrupt_results_served": corrupt_served[0],
+        "searches": searches[0],
+        "search_errors": search_errors[0],
+        "bulks": bulks[0],
+        "survivors_ok": bool(survivors_ok),
+        "segments_corrupted": int(st1["segments_corrupted"]),
+        "shards_failed_corrupt": int(st1["shards_failed_corrupt"]),
+        "copies_quarantined": int(st1["copies_quarantined"]),
+        "scrub_mismatches": int(st2["scrub_mismatches"]),
+        "scrub_repairs": int(st2["scrub_repairs"]),
+        "identical_after_repair": bool(identical),
+    }), flush=True)
+    log(f"dryrun_integrity: corrupt_served={corrupt_served[0]} "
+        f"repairs={st2['scrub_repairs']} identical={identical}")
+    return 0 if ok else 1
+
+
+
 if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
@@ -1704,4 +1863,7 @@ if __name__ == "__main__":
     if "dryrun_relocation" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_relocation":
         sys.exit(dryrun_relocation())
+    if "dryrun_integrity" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_integrity":
+        sys.exit(dryrun_integrity())
     main()

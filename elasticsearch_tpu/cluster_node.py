@@ -115,6 +115,13 @@ class ClusterNode:
             data_path, indexing_pressure=self.indexing_pressure,
             thread_pool=self.thread_pool, tasks=self.tasks,
             overload=self.overload)
+        from elasticsearch_tpu.common.integrity import IntegrityScrubber
+
+        # HBM scrub driver (ES_TPU_INTEGRITY_SCRUB_S; 0 = off): one region
+        # per tick on the management pool, skipped while overload != GREEN
+        self.integrity_scrubber = IntegrityScrubber(
+            thread_pool=self.thread_pool, overload=self.overload)
+        self.integrity_scrubber.start()
         self.applier = IndicesClusterStateService(
             node_name, self.shard_service, self.master_client)
         self.search_action = SearchActionService(
@@ -685,6 +692,7 @@ class ClusterNode:
                                   {"commands": commands, "dry_run": dry_run})
 
     def close(self) -> None:
+        self.integrity_scrubber.stop()
         for t in self._delayed_timers:
             t.cancel()
         for key in list(self.shard_service.shards):

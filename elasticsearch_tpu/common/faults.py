@@ -17,9 +17,11 @@ Spec grammar (';'-separated clauses)::
              rpc_can_match), write path (rpc_bulk, rpc_replica_bulk,
              rpc_recovery, rpc_resync) and maintenance (rpc_relocation,
              the warm-handoff RPC) — durability sites
-             (translog_fsync, translog_corrupt, segment_commit), or the
-             pressure site overload_pressure (modes pin a level instead of
-             raising: hang -> YELLOW, raise/oom -> RED)
+             (translog_fsync, translog_corrupt, segment_commit), corruption
+             sites (segment_read, segment_transfer, hbm_region — callers
+             flip bits instead of raising; the integrity plane detects),
+             or the pressure site overload_pressure (modes pin a level
+             instead of raising: hang -> YELLOW, raise/oom -> RED)
       #part  restrict to one partition id — or, for transport sites, to one
              TARGET NODE by name (``rpc_query#d1``); default: any
       mode   raise | oom | hang
@@ -74,13 +76,22 @@ OVERLOAD_SITES = frozenset({
     "overload_pressure",  # OverloadController.evaluate() injection hook
 })
 
+# Bit-flip sites (common/integrity.py): clauses here never raise at the
+# site — `corruption_fires()` tells the caller to silently damage the
+# payload, and the integrity plane must DETECT it downstream.
+CORRUPTION_SITES = frozenset({
+    "segment_read",      # segment blob read back from the shard store
+    "segment_transfer",  # recovery/relocation segment payload on the wire
+    "hbm_region",        # device-resident region at scrub verify time
+})
+
 KNOWN_SITES = frozenset({
     "turbo_sweep",       # TurboBM25 device sweep (disjunctive + bool)
     "fused_dispatch",    # ShardedTurbo fused S>1 shard_map dispatch
     "merge_kernel",      # device-side partition top-k merge
     "column_upload",     # int8 column build/refresh onto the device
     "blockmax_pass",     # BlockMax engine device pass
-}) | TRANSPORT_SITES | DURABILITY_SITES | OVERLOAD_SITES
+}) | TRANSPORT_SITES | DURABILITY_SITES | OVERLOAD_SITES | CORRUPTION_SITES
 
 _MODES = frozenset({"raise", "oom", "hang"})
 
@@ -173,9 +184,10 @@ def parse_spec(spec: str) -> List[_Clause]:
             try:
                 part = int(part_str)
             except ValueError:
-                # transport sites select by target node NAME; device sites
-                # still require an integer partition id
-                if site in TRANSPORT_SITES:
+                # transport sites select by target node NAME, corruption
+                # sites by node / region name; device sites still require
+                # an integer partition id
+                if site in TRANSPORT_SITES or site in CORRUPTION_SITES:
                     part = part_str
                 else:
                     raise FaultSpecError(
@@ -345,12 +357,15 @@ def durability_fault_point(site: str, part: Optional[Any] = None) -> None:
         + (f"#{part}" if part is not None else ""), site=site, part=part)
 
 
-def corruption_fires(part: Optional[Any] = None) -> bool:
-    """True when a `translog_corrupt` clause fires for this append: the
-    caller writes the record with a broken checksum (bit rot on the way to
-    disk) instead of raising — the damage surfaces at REPLAY time, like
-    real corruption does."""
-    return _fire_mode("translog_corrupt", part) is not None
+def corruption_fires(part: Optional[Any] = None,
+                     site: str = "translog_corrupt") -> bool:
+    """True when a corruption clause fires for this call: the caller
+    silently damages the payload (bit rot) instead of raising — the damage
+    surfaces DOWNSTREAM, at whatever checksum verify guards that leg, like
+    real corruption does. Defaults to the PR 8 `translog_corrupt` site;
+    the integrity plane passes `segment_read` / `segment_transfer` /
+    `hbm_region`."""
+    return _fire_mode(site, part) is not None
 
 
 def is_device_error(e: BaseException) -> bool:

@@ -447,6 +447,16 @@ declare_knob("ES_TPU_RETRY_BUDGET_CAP", "int", 32,
              "Retry-budget bucket capacity (and initial fill): each "
              "failover / replication / bulk / recovery / poison-solo "
              "retry spends one token")
+# data integrity plane (PR 15)
+declare_knob("ES_TPU_CHECK_ON_STARTUP", "flag", False,
+             "Re-verify every committed segment checksum before a shard "
+             "copy reports started (ref: index.shard.check_on_startup) — "
+             "corruption found here fails the copy instead of serving it")
+declare_knob("ES_TPU_INTEGRITY_SCRUB_S", "float", 0.0,
+             "HBM scrub period in seconds (0 = off): re-download one "
+             "device-resident region per tick on the management pool, "
+             "re-hash against the host-side fingerprint, re-upload on "
+             "mismatch; skipped while the overload level is not GREEN")
 
 
 class ClusterSettings:

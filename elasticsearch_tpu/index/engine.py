@@ -31,11 +31,15 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from elasticsearch_tpu.common import integrity
 from elasticsearch_tpu.common.durability import count as _count_durability
 from elasticsearch_tpu.common.errors import DocumentMissingError, VersionConflictError
-from elasticsearch_tpu.common.faults import durability_fault_point
+from elasticsearch_tpu.common.faults import corruption_fires, durability_fault_point
+from elasticsearch_tpu.common.integrity import SegmentCorruptedError
 from elasticsearch_tpu.index.segment import Segment, SegmentBuilder
-from elasticsearch_tpu.index.segment_io import segment_from_blob, segment_to_blob
+from elasticsearch_tpu.index.segment_io import (
+    segment_from_blob, segment_to_blob, verify_blob,
+)
 from elasticsearch_tpu.index.seqno import LocalCheckpointTracker, NO_OPS_PERFORMED
 from elasticsearch_tpu.index.translog import Translog, TranslogFsyncError
 from elasticsearch_tpu.mapper.mapper_service import MapperService
@@ -520,8 +524,7 @@ class InternalEngine:
             )
             seg_dir = os.path.join(self.data_path, "segments")
             for meta in commit["segments"]:
-                with open(os.path.join(seg_dir, meta["file"]), "rb") as f:
-                    seg: Segment = segment_from_blob(f.read())
+                seg: Segment = self._load_committed_segment(seg_dir, meta)
                 seg_idx = len(self._segments)
                 live = np.asarray(meta["live"], bool)
                 self._segments.append(seg)
@@ -546,6 +549,54 @@ class InternalEngine:
         if replayed:
             _count_durability("translog_replays")
             _count_durability("translog_replayed_ops", replayed)
+
+    # ---------------- integrity: at-rest verification ----------------
+
+    def _load_committed_segment(self, seg_dir: str, meta: dict) -> Segment:
+        """Read + verify one committed blob. The `segment_read` corruption
+        site flips a bit in the bytes as read (bit rot between commit and
+        reload); the footer verify inside `segment_from_blob` must catch
+        it — a failure drops a ``corrupted-*`` marker so the copy cannot
+        be reused before a fresh recovery overwrites the store."""
+        with open(os.path.join(seg_dir, meta["file"]), "rb") as f:
+            blob = f.read()
+        if corruption_fires(meta["file"], site="segment_read"):
+            blob = integrity.bitflip(blob)
+        try:
+            return segment_from_blob(blob)
+        except SegmentCorruptedError as e:
+            integrity.write_corruption_marker(
+                self.data_path, str(e), segment=meta["file"])
+            raise
+
+    def verify_store(self) -> int:
+        """Full-store checksum scan (the ES_TPU_CHECK_ON_STARTUP leg, ref:
+        index.shard.check_on_startup): re-read every committed blob and
+        verify its footer WITHOUT rebuilding segments. Returns the number
+        of blobs checked; the first failure writes a ``corrupted-*``
+        marker and raises `SegmentCorruptedError`."""
+        if self.data_path is None:
+            return 0
+        commit_path = os.path.join(self.data_path, "commit.json")
+        if not os.path.exists(commit_path):
+            return 0
+        with open(commit_path) as f:
+            commit = json.load(f)
+        seg_dir = os.path.join(self.data_path, "segments")
+        checked = 0
+        for meta in commit["segments"]:
+            with open(os.path.join(seg_dir, meta["file"]), "rb") as f:
+                blob = f.read()
+            if corruption_fires(meta["file"], site="segment_read"):
+                blob = integrity.bitflip(blob)
+            try:
+                verify_blob(blob)
+            except SegmentCorruptedError as e:
+                integrity.write_corruption_marker(
+                    self.data_path, str(e), segment=meta["file"])
+                raise
+            checked += 1
+        return checked
 
     # ---------------- peer-recovery snapshot transfer ----------------
 

@@ -7,25 +7,33 @@ peer-recovery file transfers, and on-disk commits. Deserialization never
 executes code: arrays load with ``allow_pickle=False`` and everything else
 is JSON.
 
-Blob layout::
+Blob layout (v3, written since the integrity plane)::
 
-    b"ESTPUSEG2" | u64 header_len | header JSON (utf-8) | npz payload
+    b"ESTPUSEG3" | u64 header_len | header JSON (utf-8) | npz payload
+                 | sha256(header_len .. payload) footer (32 bytes)
 
 The header carries structure (which fields exist, term dictionaries,
 doc ids, sources); the npz payload carries every numpy array keyed by a
 flat path (nested child segments recurse with a ``nested.<name>/`` key
-prefix).
+prefix). The trailing footer is the at-rest integrity leg (ref: Lucene's
+per-file CodecUtil.writeFooter checksum): `segment_from_blob` re-hashes
+on EVERY read and raises `SegmentCorruptedError` on mismatch. v2 blobs
+(no footer) remain readable — verification is skipped and the read is
+counted under `legacy_blobs_read`.
 """
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 from typing import Dict
 
 import numpy as np
 
-MAGIC = b"ESTPUSEG2"
+MAGIC = b"ESTPUSEG3"
+MAGIC_V2 = b"ESTPUSEG2"    # pre-integrity blobs: readable, unverifiable
+_FOOTER_LEN = 32           # sha256 digest size
 
 
 def _put_field_postings(fp, prefix: str, arrays: Dict[str, np.ndarray],
@@ -179,19 +187,63 @@ def segment_to_blob(seg) -> bytes:
     buf = io.BytesIO()
     np.savez(buf, **{f"a{i}": arrays[name] for i, name in enumerate(names)})
     payload = buf.getvalue()
-    return MAGIC + len(header).to_bytes(8, "big") + header + payload
+    body = len(header).to_bytes(8, "big") + header + payload
+    return MAGIC + body + hashlib.sha256(body).digest()
+
+
+def blob_hash(blob: bytes) -> str:
+    """Hex sha256 of the whole wire blob — what recovery sources advertise
+    next to each segment payload so the target can verify before install."""
+    return hashlib.sha256(blob).hexdigest()
+
+
+def verify_blob(blob: bytes) -> None:
+    """Re-hash a v3 blob against its footer; raise on mismatch.
+
+    v2 blobs pass (nothing to verify against); anything else — truncation,
+    bad magic, footer mismatch — raises `SegmentCorruptedError`."""
+    from elasticsearch_tpu.common.integrity import SegmentCorruptedError
+
+    from elasticsearch_tpu.common import integrity
+
+    if blob.startswith(MAGIC_V2):
+        return
+    if not blob.startswith(MAGIC) or len(blob) < len(MAGIC) + 8 + _FOOTER_LEN:
+        integrity.count("segments_corrupted")
+        raise SegmentCorruptedError(
+            "not a segment blob (bad magic or truncated)")
+    body, footer = blob[len(MAGIC):-_FOOTER_LEN], blob[-_FOOTER_LEN:]
+    digest = hashlib.sha256(body).digest()
+    if digest != footer:
+        integrity.count("segments_corrupted")
+        raise SegmentCorruptedError(
+            f"segment blob failed checksum verification: footer "
+            f"{footer.hex()[:16]}.. != computed {digest.hex()[:16]}..")
+    integrity.count("segments_verified")
+    integrity.count("bytes_verified", len(blob))
 
 
 def segment_from_blob(blob: bytes):
-    """Rebuild a Segment from a blob. Never unpickles."""
-    if not blob.startswith(MAGIC):
+    """Rebuild a Segment from a blob, verifying the checksum footer on
+    every read. Never unpickles."""
+    from elasticsearch_tpu.common import integrity
+
+    if blob.startswith(MAGIC_V2):
+        # pre-footer blob: parseable but unverifiable (counted, so fleets
+        # can watch the legacy population drain as segments rewrite)
+        integrity.count("legacy_blobs_read")
+        magic, end = MAGIC_V2, len(blob)
+    elif blob.startswith(MAGIC):
+        verify_blob(blob)
+        magic, end = MAGIC, len(blob) - _FOOTER_LEN
+    else:
         raise ValueError(
             "not a segment blob (bad magic); refusing to parse — legacy "
             "pickled segments are unsupported (reindex from source)")
-    hlen = int.from_bytes(blob[len(MAGIC): len(MAGIC) + 8], "big")
-    off = len(MAGIC) + 8
+    hlen = int.from_bytes(blob[len(magic): len(magic) + 8], "big")
+    off = len(magic) + 8
     meta = json.loads(blob[off: off + hlen].decode())
-    npz = np.load(io.BytesIO(blob[off + hlen:]), allow_pickle=False)
+    npz = np.load(io.BytesIO(blob[off + hlen: end]), allow_pickle=False)
     names = meta.pop("__array_names__")
     arrays = {name: npz[f"a{i}"] for i, name in enumerate(names)}
     return _rebuild_segment(meta, "", arrays)

@@ -25,6 +25,9 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.cluster.state import ClusterState
+from elasticsearch_tpu.common import integrity
+from elasticsearch_tpu.common.integrity import SegmentCorruptedError
+from elasticsearch_tpu.common.settings import knob
 from elasticsearch_tpu.indices.shard_service import DistributedShardService
 
 
@@ -74,30 +77,18 @@ class IndicesClusterStateService:
                 continue
             inst = self.shards.shards.get((r.index, r.shard_id))
             if inst is None:
-                if (r.state == "INITIALIZING"
-                        and r.relocating_node_id is not None):
-                    # relocation target: even when routing carries the
-                    # primary flag, the source keeps the primary context
-                    # until the swap — this copy recovers as a replica
-                    # (peer recovery from the serving primary), warms its
-                    # HBM/compile caches, then reports started
-                    from dataclasses import replace as _replace
-
-                    inst = self.shards.create_shard(
-                        meta, _replace(r, primary=False))
-                    self._defer_recovery(
-                        inst, relocation_source=r.relocating_node_id)
-                elif r.primary:
-                    inst = self.shards.create_shard(meta, r)
-                    # fresh (or locally-recovered) primary: started
-                    inst.state = "STARTED" if r.state == "STARTED" \
-                        else "INITIALIZING"
-                    if r.state == "INITIALIZING":
-                        self._defer_report_started(inst)
-                        inst.state = "STARTED"
-                else:
-                    inst = self.shards.create_shard(meta, r)
-                    self._defer_recovery(inst)
+                try:
+                    self._create_local_shard(meta, r)
+                except SegmentCorruptedError as e:
+                    # corruption fails the COPY, never the applier: the
+                    # corrupted-* marker (written where the verify failed)
+                    # blocks this store from serving again, and the
+                    # deferred shard-failed report routes through the same
+                    # seam every other copy failure uses — the master
+                    # reallocates from a healthy peer
+                    integrity.count("shards_failed_corrupt")
+                    self.shards.remove_shard(r.index, r.shard_id)
+                    self._defer_report_failed(r, f"corrupted: {e}")
             else:
                 new_term = meta.primary_term(r.shard_id)
                 still_reloc_target = (r.state == "INITIALIZING"
@@ -112,6 +103,51 @@ class IndicesClusterStateService:
                     else inst.state
                 if inst.primary and inst.tracker is not None:
                     self._sync_tracker(inst, state, meta)
+
+    def _create_local_shard(self, meta, r) -> None:
+        """One new assignment: build the engine and schedule whatever must
+        happen before the copy reports started. Raises
+        `SegmentCorruptedError` when the store cannot serve (marker, failed
+        checksum on commit load, or a failed startup scan)."""
+        if r.state == "INITIALIZING" and r.relocating_node_id is not None:
+            # relocation target: even when routing carries the
+            # primary flag, the source keeps the primary context
+            # until the swap — this copy recovers as a replica
+            # (peer recovery from the serving primary), warms its
+            # HBM/compile caches, then reports started
+            from dataclasses import replace as _replace
+
+            inst = self.shards.create_shard(
+                meta, _replace(r, primary=False))
+            self._defer_recovery(
+                inst, relocation_source=r.relocating_node_id)
+        elif r.primary:
+            inst = self.shards.create_shard(meta, r)
+            # fresh (or locally-recovered) primary: started
+            inst.state = "STARTED" if r.state == "STARTED" \
+                else "INITIALIZING"
+            if r.state == "INITIALIZING":
+                self._verify_on_startup(inst)
+                self._defer_report_started(inst)
+                inst.state = "STARTED"
+        else:
+            inst = self.shards.create_shard(meta, r)
+            self._defer_recovery(inst)
+
+    def _verify_on_startup(self, inst) -> None:
+        """ES_TPU_CHECK_ON_STARTUP: full-store checksum scan BEFORE the
+        copy reports started (ref: index.shard.check_on_startup) — the
+        commit load only re-reads blobs it rebuilds, this re-reads all of
+        them, so bit rot under an already-loaded store is caught here
+        instead of at the next recovery."""
+        if not knob("ES_TPU_CHECK_ON_STARTUP"):
+            return
+        integrity.count("startup_checks")
+        try:
+            inst.engine.verify_store()
+        except SegmentCorruptedError:
+            integrity.count("startup_failures")
+            raise
 
     def _sync_tracker(self, inst, state: ClusterState, meta) -> None:
         """Keep the primary's replication tracker consistent with the
@@ -131,6 +167,15 @@ class IndicesClusterStateService:
 
         def report():
             self.master_client("internal:cluster/shard/started", payload)
+
+        self._post_apply.append(report)
+
+    def _defer_report_failed(self, r, reason: str) -> None:
+        payload = {"index": r.index, "shard_id": r.shard_id,
+                   "allocation_id": r.allocation_id, "reason": reason}
+
+        def report():
+            self.master_client("internal:cluster/shard/failed", payload)
 
         self._post_apply.append(report)
 
@@ -164,6 +209,18 @@ class IndicesClusterStateService:
                     last_err = None
                     break
                 except Exception as e:  # noqa: BLE001
+                    last_err = e
+            if last_err is None:
+                try:
+                    # the freshly recovered (and flushed) store replaces
+                    # whatever corruption got this copy here: any marker
+                    # left in the data path is stale now
+                    if inst.engine.data_path is not None:
+                        integrity.clear_corruption_markers(
+                            inst.engine.data_path)
+                    self._verify_on_startup(inst)
+                except SegmentCorruptedError as e:
+                    integrity.count("shards_failed_corrupt")
                     last_err = e
             if last_err is not None:
                 self.master_client(

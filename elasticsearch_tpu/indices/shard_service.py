@@ -29,12 +29,17 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.analysis import AnalysisRegistry
+from elasticsearch_tpu.common import integrity
 from elasticsearch_tpu.common.durability import count as _count
 from elasticsearch_tpu.common.errors import (
     ElasticsearchTpuError, VersionConflictError,
 )
+from elasticsearch_tpu.common.faults import corruption_fires
+from elasticsearch_tpu.common.integrity import SegmentCorruptedError
+from elasticsearch_tpu.common.settings import knob
 from elasticsearch_tpu.cluster.state import ClusterState, IndexMetadata, ShardRouting
 from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.index.segment_io import blob_hash
 from elasticsearch_tpu.index.replication import resync_target_apply
 from elasticsearch_tpu.index.seqno import NO_OPS_PERFORMED, ReplicationTracker
 from elasticsearch_tpu.index.translog import (
@@ -177,22 +182,31 @@ class DistributedShardService:
             path = os.path.join(self.data_path, meta.index,
                                 str(routing.shard_id))
         durability = meta.settings.raw("index.translog.durability", "request")
+        marker = integrity.corruption_marker(path) if path else None
+        if marker is not None:
+            # a previous incarnation of this copy failed checksum
+            # verification and dropped a corrupted-* marker: the store must
+            # never serve again as-is. A replica quarantines it and
+            # re-bootstraps via peer recovery; a primary assignment is
+            # refused outright (the master must pick a healthy copy).
+            if routing.primary:
+                raise SegmentCorruptedError(
+                    f"store [{path}] is marked corrupted: "
+                    f"{marker.get('reason')}")
+            self._quarantine_store(path)
         try:
             engine = InternalEngine(
                 mapper, data_path=path,
                 primary_term=meta.primary_term(routing.shard_id),
                 translog_durability=durability)
-        except TranslogCorruptedError:
+        except (TranslogCorruptedError, SegmentCorruptedError):
             # a replica's store is expendable: quarantine the damaged dir and
             # re-bootstrap empty via peer recovery (ref: the reference drops
             # a corrupt replica store and recovers from the primary). A
             # primary has nothing to recover FROM — surface the corruption.
             if routing.primary or path is None:
                 raise
-            import shutil
-            shutil.rmtree(path + ".corrupt", ignore_errors=True)
-            os.rename(path, path + ".corrupt")
-            _count("store_corruptions_discarded")
+            self._quarantine_store(path)
             engine = InternalEngine(
                 mapper, data_path=path,
                 primary_term=meta.primary_term(routing.shard_id),
@@ -209,6 +223,20 @@ class DistributedShardService:
         with self._registry_lock:
             self.shards[(meta.index, routing.shard_id)] = inst
         return inst
+
+    @staticmethod
+    def _quarantine_store(path: str) -> None:
+        """Move a damaged store (and its corrupted-* marker) aside so a
+        fresh peer recovery can rebuild into a clean directory."""
+        import os
+        import shutil
+
+        if not os.path.isdir(path):
+            return
+        shutil.rmtree(path + ".corrupt", ignore_errors=True)
+        os.rename(path, path + ".corrupt")
+        _count("store_corruptions_discarded")
+        integrity.count("copies_quarantined")
 
     def remove_shard(self, index: str, shard_id: int) -> None:
         with self._registry_lock:
@@ -444,10 +472,18 @@ class DistributedShardService:
             # max_seq_no it is stamped with form one consistent point in
             # time (a concurrent bulk holds the same lock)
             payloads, max_seq_no = inst.engine.segment_payloads()
-        return {"segments": [
-            {"blob": base64.b64encode(blob).decode("ascii"),
-             "live": live.tolist()} for blob, live in payloads],
-            "max_seq_no": max_seq_no}
+        segments = []
+        for blob, live in payloads:
+            # the advertised hash is computed BEFORE the wire: an injected
+            # `segment_transfer` clause damages the payload after it (bit
+            # rot in transit), so the hash stays pristine and the TARGET
+            # must detect the mismatch and re-fetch
+            digest = blob_hash(blob)
+            if corruption_fires(self.node_name, site="segment_transfer"):
+                blob = integrity.bitflip(blob)
+            segments.append({"blob": base64.b64encode(blob).decode("ascii"),
+                             "live": live.tolist(), "hash": digest})
+        return {"segments": segments, "max_seq_no": max_seq_no}
 
     def _on_recovery_ops(self, req) -> dict:
         p = req.payload
@@ -549,8 +585,7 @@ class DistributedShardService:
         # phase1 (file phase): install the segment snapshot when this copy
         # is empty — segments are the recovery files
         if was_empty:
-            seg_resp = self.channels.request(
-                source, "internal:index/shard/recovery/segments", shard_ref)
+            seg_resp = self._fetch_verified_segments(source, shard_ref)
             for seg in seg_resp["segments"]:
                 inst.engine.install_segment(
                     base64.b64decode(seg["blob"]), seg["live"])
@@ -592,6 +627,37 @@ class DistributedShardService:
         inst.known_global_checkpoint = max(
             inst.known_global_checkpoint, fin["global_checkpoint"])
         inst.engine.flush()
+
+    def _fetch_verified_segments(self, source: str, shard_ref: dict) -> dict:
+        """Phase1 fetch with in-flight verification: every segment payload
+        is re-hashed against the hash the source advertised (computed on
+        the source BEFORE the wire). A mismatch means transfer corruption —
+        re-fetch immediately, bounded by `ES_TPU_RECOVERY_RETRIES`, counted
+        under `transfer_retries` (SEPARATE from the node-unavailable retry
+        loop in cluster_state_service, which handles dead sources)."""
+        retries = max(0, int(knob("ES_TPU_RECOVERY_RETRIES")))
+        attempt = 0
+        while True:
+            resp = self.channels.request(
+                source, "internal:index/shard/recovery/segments", shard_ref)
+            clean = True
+            for seg in resp["segments"]:
+                want = seg.get("hash")
+                if want is None:
+                    continue   # pre-integrity source: nothing to check
+                if blob_hash(base64.b64decode(seg["blob"])) != want:
+                    clean = False
+                    break
+                integrity.count("transfer_hashes_verified")
+            if clean:
+                return resp
+            integrity.count("transfer_corruptions")
+            if attempt >= retries:
+                raise SegmentCorruptedError(
+                    f"recovery segment payload from [{source}] failed hash "
+                    f"verification {attempt + 1}x (transfer corruption)")
+            attempt += 1
+            integrity.count("transfer_retries")
 
     @staticmethod
     def _apply_recovery_ops(inst: ShardInstance, ops: List[dict],

@@ -131,7 +131,16 @@ class Node:
         from elasticsearch_tpu.snapshots import SnapshotsService
 
         self.snapshots = SnapshotsService(
-            self.indices, lambda name, body: self.create_index(name, body))
+            self.indices, lambda name, body: self.create_index(name, body),
+            delete_index=self.delete_index)
+        from elasticsearch_tpu.common.integrity import IntegrityScrubber
+
+        # HBM scrub driver (ES_TPU_INTEGRITY_SCRUB_S; 0 = off): walks the
+        # registered device regions on the management pool, yields while
+        # the overload level is not GREEN
+        self.integrity_scrubber = IntegrityScrubber(
+            thread_pool=self.thread_pool, overload=self.overload)
+        self.integrity_scrubber.start()
         self._register_actions()
 
     # ---- cluster-state updates (single-threaded master semantics,
@@ -187,6 +196,7 @@ class Node:
             lambda req: (self.indices.get(req.payload["index"]).refresh(), {"ok": True})[1])
 
     def close(self) -> None:
+        self.integrity_scrubber.stop()
         self.indices.close()
         self.transport.close()
         self.thread_pool.shutdown()

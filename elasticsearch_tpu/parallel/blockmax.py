@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from elasticsearch_tpu.common import faults, hbm_ledger
+from elasticsearch_tpu.common import faults, hbm_ledger, integrity
 from elasticsearch_tpu.common.health import EngineHealth
 from elasticsearch_tpu.parallel.compat import SHARD_MAP_RETRACE_SAFE, shard_map
 from elasticsearch_tpu.ops import bm25_idf, next_bucket
@@ -128,6 +128,14 @@ class BlockMaxBM25:
         self._hbm.set_region("block_scores", stacked.block_scores.nbytes)
         self._hbm.set_region("live", stacked.live.nbytes)
         self._hbm.set_region("hot_cols", self.hot_cols.nbytes)
+        # integrity plane: hot_cols is this engine's own upload — scrub it
+        # against a per-epoch baseline and repair by a deterministic
+        # rebuild from host postings; repeated mismatches trip `health`
+        integrity.register_scrub_region(
+            self, "hot_cols", lambda o: o.hot_cols,
+            epoch=lambda o: id(o.hot_cols),
+            repair=lambda o: o._build_hot_columns())
+        integrity.attach_scrub_health(self, self.health)
 
     # ---------------- build ----------------
 

@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from elasticsearch_tpu.common import hbm_ledger
+from elasticsearch_tpu.common import hbm_ledger, integrity
 from elasticsearch_tpu.parallel.compat import shard_map
 from elasticsearch_tpu.index.segment import FieldPostings, Segment
 from elasticsearch_tpu.ops import BLOCK, bm25_idf, next_bucket
@@ -747,6 +747,35 @@ class Bm25ColumnCache:
         self._hbm = hbm_ledger.register_engine(
             self, "spmd_cache", devices=len(mesh.devices.flat))
         self._hbm.set_region("cache", self.cache.nbytes)
+        # integrity plane: the slot cache is device-built, so it scrubs
+        # against a per-epoch baseline (array identity changes on every
+        # legitimate insert) and repairs by dropping to empty — columns
+        # rebuild lazily on the next ensure_terms
+        integrity.register_scrub_region(
+            self, "cache", lambda o: o.cache,
+            epoch=lambda o: id(o.cache),
+            repair=lambda o: o.reset_cache())
+
+    def reset_cache(self) -> None:
+        """Drop every cached column (scrub repair / corruption recovery):
+        the device cache re-zeroes and the slot pool restarts empty."""
+        from elasticsearch_tpu.common import faults
+
+        with self._lock:
+            freed = len(self.term_slot)
+            # translation only (device_errors, no fault_point): the repair
+            # upload must not be a separately injectable rung
+            with faults.device_errors("column_upload"):
+                self.cache = jax.device_put(
+                    jnp.zeros(self.cache.shape, jnp.float32),
+                    NamedSharding(self.mesh, P("shard")))
+            if freed:
+                self._hbm.note_eviction(
+                    count=freed, freed_bytes=self._slot_bytes * freed)
+            self.term_slot.clear()
+            self.term_idf.clear()
+            self._lru.clear()
+            self._free = list(range(self.capacity))
 
     def hbm_bytes(self) -> int:
         return self.cache.nbytes

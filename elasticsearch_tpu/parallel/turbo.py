@@ -48,7 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
-from elasticsearch_tpu.common import faults, hbm_ledger, tracing
+from elasticsearch_tpu.common import faults, hbm_ledger, integrity, tracing
 from elasticsearch_tpu.common.errors import DeviceFaultError
 from elasticsearch_tpu.common.faults import FaultRecord
 from elasticsearch_tpu.index.positions import phrase_freqs
@@ -237,13 +237,16 @@ class TurboBM25:
              for s in qc_sizes}))
 
         fp = self.fp
-        # lane arrays with trailing DMA padding rows
+        # lane arrays with trailing DMA padding rows; the padded host
+        # copies stay retained as the scrubber's authoritative fingerprint
+        # (and repair) source for these device regions
         pad = np.zeros((MAX_GROUP_ROWS, 128), np.int32)
-        self.lane_docs = jnp.asarray(
-            np.concatenate([fp.block_docs, pad], axis=0))
+        self._lane_docs_host = np.concatenate([fp.block_docs, pad], axis=0)
+        self.lane_docs = jnp.asarray(self._lane_docs_host)
         bs = _host_block_scores(fp, self._avgdl)
-        self.lane_scores = jnp.asarray(
-            np.concatenate([bs, pad.astype(np.float32)], axis=0))
+        self._lane_scores_host = np.concatenate(
+            [bs, pad.astype(np.float32)], axis=0)
+        self.lane_scores = jnp.asarray(self._lane_scores_host)
         self._host_scores = bs       # [T, 128] idf-free lane scores
         # per-block doc ranges for group building (pad lanes are 0 so the
         # row max is the true last doc; row 0 is the reserved zero block)
@@ -295,6 +298,7 @@ class TurboBM25:
         # telemetry cross-check can hold ledger == engine to the byte
         self._hbm = hbm_ledger.register_engine(self, "turbo")
         self._register_hbm_regions()
+        self._register_scrub_regions()
 
     def _register_hbm_regions(self) -> None:
         self._hbm.set_region("cols_hi", self.cols_hi.nbytes)
@@ -302,6 +306,34 @@ class TurboBM25:
         self._hbm.set_region("lane_docs", self.lane_docs.nbytes)
         self._hbm.set_region("lane_scores", self.lane_scores.nbytes)
         self._hbm.set_region("live", self.live.nbytes)
+
+    def _register_scrub_regions(self) -> None:
+        """Integrity-plane fingerprints next to the ledger registrations:
+        host-sourced regions are host-backed (repair = re-upload the
+        retained copy); the column cache is device-built, so it scrubs
+        against a per-epoch baseline — jax arrays rebind on every
+        legitimate functional update, making array identity the epoch —
+        and repairs by dropping the cache (rebuilds lazily, certified)."""
+        integrity.register_scrub_region(
+            self, "live", lambda o: o.live,
+            expected=lambda o: o._live_host,
+            repair=lambda o: setattr(o, "live", jnp.asarray(
+                o._live_host.reshape(o.dp_rows, 128))))
+        integrity.register_scrub_region(
+            self, "lane_docs", lambda o: o.lane_docs,
+            expected=lambda o: o._lane_docs_host,
+            repair=lambda o: setattr(
+                o, "lane_docs", jnp.asarray(o._lane_docs_host)))
+        integrity.register_scrub_region(
+            self, "lane_scores", lambda o: o.lane_scores,
+            expected=lambda o: o._lane_scores_host,
+            repair=lambda o: setattr(
+                o, "lane_scores", jnp.asarray(o._lane_scores_host)))
+        for name in ("cols_hi", "cols_lo"):
+            integrity.register_scrub_region(
+                self, name, lambda o, n=name: getattr(o, n),
+                epoch=lambda o, n=name: id(getattr(o, n)),
+                repair=lambda o: o._reset_columns())
 
     def hbm_bytes(self) -> int:
         return (self.cols_hi.nbytes + self.cols_lo.nbytes
@@ -1562,6 +1594,7 @@ class ShardedTurbo:
             self.cols_hi = jax.device_put(zeros, sh)
             self.cols_lo = jax.device_put(zeros, sh)
         self._sharding = sh
+        self._live_host = lv     # retained: scrub fingerprint + repair src
         self._epochs = [-1] * S
         self.fused_dispatches = 0
         # fused cache is a separate device allocation on top of the
@@ -1569,11 +1602,41 @@ class ShardedTurbo:
         self._hbm = hbm_ledger.register_engine(
             self, "fused_turbo", devices=G)
         self._register_hbm_regions()
+        self._register_scrub_regions()
 
     def _register_hbm_regions(self) -> None:
         self._hbm.set_region("cols_hi", self.cols_hi.nbytes)
         self._hbm.set_region("cols_lo", self.cols_lo.nbytes)
         self._hbm.set_region("live", self.live.nbytes)
+
+    def _register_scrub_regions(self) -> None:
+        integrity.register_scrub_region(
+            self, "live", lambda o: o.live,
+            expected=lambda o: o._live_host,
+            repair=lambda o: o._repair_live())
+        for name in ("cols_hi", "cols_lo"):
+            integrity.register_scrub_region(
+                self, name, lambda o, n=name: getattr(o, n),
+                epoch=lambda o, n=name: id(getattr(o, n)),
+                repair=lambda o: o._reset_fused_columns())
+
+    def _repair_live(self) -> None:
+        """Scrub repair: re-upload the live mask from the host copy."""
+        # translation only (device_errors, no fault_point): repairs must
+        # not be separately injectable rungs
+        with faults.device_errors("column_upload"):
+            self.live = jax.device_put(self._live_host, self._sharding)
+
+    def _reset_fused_columns(self) -> None:
+        """Scrub repair: zero the fused cache and re-sync every partition
+        slice from the per-partition engines (their own caches are scrubbed
+        separately), restoring bit-identical column state."""
+        zeros = np.zeros(self.cols_hi.shape, np.int8)
+        with faults.device_errors("column_upload"):
+            self.cols_hi = jax.device_put(zeros, self._sharding)
+            self.cols_lo = jax.device_put(zeros, self._sharding)
+        self._epochs = [-1] * len(self.turbos)
+        self._refresh()
 
     def extend_qc_sizes(self, sizes) -> None:
         """Bucket-ladder hook, fused flavor: keeps the fused chunker and

@@ -143,6 +143,7 @@ def register_handlers(node: Node, rc: RestController) -> None:
     # snapshots (ref: RestPutRepositoryAction, RestCreateSnapshotAction,
     # RestRestoreSnapshotAction, RestDeleteSnapshotAction)
     r("PUT", "/_snapshot/{repo}", h.put_repository)
+    r("POST", "/_snapshot/{repo}/_verify", h.verify_repository)
     r("GET", "/_snapshot/{repo}", h.get_repository)
     r("PUT", "/_snapshot/{repo}/{snapshot}", h.create_snapshot)
     r("POST", "/_snapshot/{repo}/{snapshot}", h.create_snapshot)
@@ -1740,6 +1741,12 @@ class _Handlers:
         return _ok({repo.name: {"type": "fs",
                                 "settings": {"location": repo.location}}})
 
+    def verify_repository(self, req: RestRequest) -> RestResponse:
+        """POST /_snapshot/{repo}/_verify — probe round-trip plus a full
+        re-hash of every referenced segment blob (integrity plane, PR 15);
+        corrupt blobs come back as per-index lists, not a bare boolean."""
+        return _ok(self.node.snapshots.verify_repository(req.param("repo")))
+
     def create_snapshot(self, req: RestRequest) -> RestResponse:
         body = dict(req.body or {})
         indices = body.get("indices")
@@ -2120,6 +2127,7 @@ class _Handlers:
             "tpu_tasks": self.node.tasks.stats(),
             "tpu_overload": self.node.overload.stats(),
             "tpu_relocation": _tpu_relocation_stats(),
+            "tpu_integrity": _tpu_integrity_stats(),
             "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
         }
 
@@ -2628,6 +2636,18 @@ def _tpu_relocation_stats() -> dict:
     from elasticsearch_tpu.common.relocation import relocation_stats
 
     return relocation_stats()
+
+
+def _tpu_integrity_stats() -> dict:
+    """Data-integrity plane section (PR 15): segments verified/corrupted at
+    rest, transfer hash verifications and retried transfers, corruption
+    markers written/cleared, shard copies failed or quarantined for
+    corruption, HBM scrub outcomes (ticks, mismatches, repairs, yields),
+    repository verifies, and restore cleanups — the audit surface for the
+    three integrity legs."""
+    from elasticsearch_tpu.common.integrity import integrity_stats
+
+    return integrity_stats()
 
 
 def _deep_merge(base: dict, patch: dict) -> dict:

@@ -464,6 +464,13 @@ class TurboEngine:
         self.mesh = mesh
         self._sharded = None
         self.health = EngineHealth("turbo")
+        from elasticsearch_tpu.common import integrity
+
+        for t in self.turbos:
+            # repeated HBM-scrub mismatches in any partition's regions trip
+            # the SAME circuit dispatch faults do — a rotting device stops
+            # serving and falls back to the host tier
+            integrity.attach_scrub_health(t, self.health)
         self._stats_lock = threading.Lock()
         self.merge_stats = {"merge_device": 0, "merge_host": 0,
                             "partition_dispatches": 0,
@@ -481,9 +488,11 @@ class TurboEngine:
         if self.mesh is None or len(self.turbos) < 2:
             return None
         if self._sharded is None:
+            from elasticsearch_tpu.common import integrity
             from elasticsearch_tpu.parallel.turbo import ShardedTurbo
 
             self._sharded = ShardedTurbo(self.turbos, self.mesh)
+            integrity.attach_scrub_health(self._sharded, self.health)
         return self._sharded
 
     @property

@@ -200,6 +200,56 @@ class FsRepository:
             pass
         self._gc_blobs()
 
+    # ---- verification (integrity plane, PR 15) ----
+
+    def verify_probe(self) -> None:
+        """Write a probe blob, read it back byte-for-byte, delete it.
+
+        Proves the repository location is writable AND readable by this
+        node before trusting it for snapshot traffic (ref:
+        BlobStoreRepository#startVerification writes a master.dat probe)."""
+        import uuid
+
+        name = f"probe-{uuid.uuid4().hex[:12]}.dat"
+        payload = name.encode() + os.urandom(64)
+        path = self._path(name)
+        try:
+            with open(path, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            with open(path, "rb") as f:
+                back = f.read()
+            if back != payload:
+                raise RepositoryError(
+                    f"repository [{self.name}] probe round-trip mismatch "
+                    f"at [{self.location}]")
+        except OSError as e:
+            raise RepositoryError(
+                f"repository [{self.name}] is not accessible at "
+                f"[{self.location}]: {e}")
+        finally:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def referenced_blobs_by_index(self) -> Dict[str, set]:
+        """{index_name: {blob hash}} across ALL snapshots' manifests."""
+        refs: Dict[str, set] = {}
+        base = self._path("indices")
+        if not os.path.isdir(base):
+            return refs
+        for index in os.listdir(base):
+            for root, _, files in os.walk(os.path.join(base, index)):
+                for fn in files:
+                    if fn.startswith("manifest-"):
+                        with open(os.path.join(root, fn)) as f:
+                            m = json.load(f)
+                        refs.setdefault(index, set()).update(
+                            s["blob"] for s in m.get("segments", []))
+        return refs
+
     def _referenced_blobs(self) -> set:
         refs = set()
         base = self._path("indices")

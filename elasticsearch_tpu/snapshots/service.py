@@ -32,9 +32,11 @@ class InvalidSnapshotNameError(ElasticsearchTpuError):
 
 
 class SnapshotsService:
-    def __init__(self, indices, create_index: Callable[[str, dict], object]):
+    def __init__(self, indices, create_index: Callable[[str, dict], object],
+                 delete_index: Optional[Callable[[str], None]] = None):
         self.indices = indices
         self._create_index = create_index
+        self._delete_index = delete_index
         self.repositories: Dict[str, FsRepository] = {}
 
     # ---- repositories ----
@@ -170,17 +172,84 @@ class SnapshotsService:
             body = {"settings": imeta.get("settings", {}),
                     "mappings": imeta.get("mappings", {})}
             self._create_index(target, body)
-            svc = self.indices.get(target)
-            if len(svc.shards) != imeta["number_of_shards"]:
-                raise IllegalArgumentError(
-                    f"restored index [{target}] shard count mismatch")
-            for sid, engine in enumerate(svc.shards):
-                manifest = repo.read_shard_manifest(index, sid, snap_name)
-                for seg in manifest["segments"]:
-                    blob = repo.read_segment_blob(seg["blob"])
-                    engine.install_segment(blob, _mask_from_wire(seg["live"]))
-                engine.fill_seqno_gaps(int(manifest["max_seq_no"]))
+            try:
+                svc = self.indices.get(target)
+                if len(svc.shards) != imeta["number_of_shards"]:
+                    raise IllegalArgumentError(
+                        f"restored index [{target}] shard count mismatch")
+                for sid, engine in enumerate(svc.shards):
+                    manifest = repo.read_shard_manifest(index, sid, snap_name)
+                    for seg in manifest["segments"]:
+                        blob = repo.read_segment_blob(seg["blob"])
+                        engine.install_segment(
+                            blob, _mask_from_wire(seg["live"]))
+                    engine.fill_seqno_gaps(int(manifest["max_seq_no"]))
+            except Exception:
+                # a restore that dies mid-install (corrupt/missing blob,
+                # shape mismatch) must not leave a half-populated index
+                # behind — it would mask the failure AND block a retry with
+                # ResourceAlreadyExists (ref: RestoreService cleans up the
+                # restoring index on failure); the ORIGINAL error surfaces
+                self._cleanup_failed_restore(target)
+                raise
             restored.append(target)
         return {"snapshot": {"snapshot": snap_name, "indices": restored,
                              "shards": {"total": len(restored), "failed": 0,
                                         "successful": len(restored)}}}
+
+    def _cleanup_failed_restore(self, target: str) -> None:
+        from elasticsearch_tpu.common import integrity
+
+        try:
+            if self._delete_index is not None:
+                self._delete_index(target)
+            else:
+                self.indices.delete_index(target)
+            integrity.count("restore_cleanups")
+        except Exception:   # noqa: BLE001 — never shadow the restore error
+            pass
+
+    # ---- verify ----
+
+    def verify_repository(self, repo_name: str) -> dict:
+        """POST /_snapshot/{repo}/_verify: probe write/read round-trip plus
+        a full re-hash of every segment blob referenced by any manifest.
+
+        The reference's verify only proves the repository is writable from
+        each node; with a content-addressed store we can go further and
+        prove every *referenced* byte still matches its address — a bit
+        flip in a repository blob is found here, not at restore time."""
+        from elasticsearch_tpu.common import integrity
+
+        repo = self.repository(repo_name)
+        with repo.mutation_lock:
+            repo.verify_probe()
+            refs_by_index = repo.referenced_blobs_by_index()
+            checked = 0
+            corrupt: Dict[str, List[str]] = {}
+            seen_bad: Dict[str, bool] = {}
+            for index in sorted(refs_by_index):
+                bad = []
+                for h in sorted(refs_by_index[index]):
+                    if h in seen_bad:
+                        ok = not seen_bad[h]
+                    else:
+                        checked += 1
+                        try:
+                            repo.read_segment_blob(h)
+                            ok = True
+                        except RepositoryError:
+                            ok = False
+                        seen_bad[h] = not ok
+                        if not ok:
+                            integrity.count("repo_corrupt_blobs")
+                    if not ok:
+                        bad.append(h)
+                if bad:
+                    corrupt[index] = bad
+        integrity.count("repo_verifies")
+        return {"repository": repo_name, "probe": "ok",
+                "blobs_checked": checked,
+                "corrupt_blob_count": sum(len(v) for v in corrupt.values()),
+                "corrupt": corrupt,
+                "verified": not corrupt}

@@ -335,3 +335,46 @@ def test_seeded_chaos_storm(tmp_path):
     final_reads(cluster, history, keyspace)
     assert history.check() == []
     assert durability_stats()["recoveries_started"] >= 1
+
+
+def test_disk_corruption_promotes_replica_and_heals_copy(tmp_path):
+    """Scenario 11 (integrity plane, PR 15): a committed primary segment
+    rots on disk while the node is down; the restarted node discovers the
+    flip at commit load (checksum footer), refuses the copy, and the
+    master promotes the replica; the corrupted store is quarantined and
+    re-recovers from the healthy peer. Writes keep flowing throughout and
+    the acked-write history stays linearizable."""
+    import glob
+    import os
+
+    from elasticsearch_tpu.common import integrity
+
+    integrity.reset_for_tests()
+    cluster = make_cluster(tmp_path)
+    history = AckedWriteHistory()
+    docs = [f"doc{i}" for i in range(10)]
+    acked_bulk(cluster, history, [write_op(d, 1) for d in docs])
+    victim = node_of_copy(cluster, "docs", 0, primary=True)
+    survivor = node_of_copy(cluster, "docs", 0, primary=False)
+    cluster.primary_instance("docs", docs[0]).engine.flush()
+    # fast restart (report=False): the master never saw the crash, so the
+    # corruption itself — not failure detection — must fail the copy
+    cluster.crash(victim, report=False)
+    seg = glob.glob(os.path.join(
+        str(tmp_path), victim, "docs", "0", "segments", "*.seg"))[0]
+    with open(seg, "rb") as f:
+        data = f.read()
+    with open(seg, "wb") as f:
+        f.write(integrity.bitflip(data))
+    cluster.restart(victim)
+    stats = integrity.integrity_stats()
+    assert stats["segments_corrupted"] >= 1
+    assert stats["shards_failed_corrupt"] >= 1
+    assert stats["copies_quarantined"] >= 1
+    assert node_of_copy(cluster, "docs", 0, primary=True) == survivor
+    # the healed copy is tracked in-sync and serves subsequent writes
+    inst = cluster.primary_instance("docs", docs[0])
+    assert len(inst.tracker.in_sync_ids) == 2
+    acked_bulk(cluster, history, [write_op(d, 2) for d in docs[:4]])
+    final_reads(cluster, history, docs)
+    assert history.check() == []

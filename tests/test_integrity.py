@@ -1,0 +1,501 @@
+"""End-to-end data integrity plane tests (PR 15).
+
+Three legs, each differential where it counts:
+
+  * at rest  — every committed segment blob carries a sha256 footer
+               (v3 wire format); reads verify, corruption drops a
+               corrupted-* marker and fails the COPY through the same
+               shard-failed seam every other failure uses;
+  * in flight — peer-recovery segment payloads ship with the source's
+               pre-wire hash; the target verifies before install and
+               re-fetches on mismatch (bounded, counted separately from
+               node-unavailable retries);
+  * in HBM   — engines register device-resident regions with host-side
+               fingerprints; the scrubber detects injected bit flips and
+               repairs from the host copy, and scrub-on vs scrub-off
+               search results are bit-identical.
+
+Cluster scenarios run on the synchronous CrashRestartCluster harness
+(testing/chaos.py) — no sleeps, no polling.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common import faults, integrity
+from elasticsearch_tpu.common.durability import reset_for_tests as _dur_reset
+from elasticsearch_tpu.common.faults import inject
+from elasticsearch_tpu.common.integrity import SegmentCorruptedError
+from elasticsearch_tpu.index.engine import InternalEngine
+from elasticsearch_tpu.index.segment_io import (
+    MAGIC, MAGIC_V2, blob_hash, segment_from_blob, verify_blob,
+)
+from elasticsearch_tpu.mapper import MapperService
+from elasticsearch_tpu.testing.chaos import CrashRestartCluster
+
+MAPPINGS = {"properties": {"n": {"type": "integer"},
+                           "body": {"type": "text"}}}
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    integrity.reset_for_tests()
+    integrity.reset_scrub_for_tests()
+    _dur_reset()
+    yield
+    faults.clear()
+    integrity.reset_for_tests()
+    integrity.reset_scrub_for_tests()
+    _dur_reset()
+
+
+def make_engine(path=None):
+    return InternalEngine(MapperService(dict(MAPPINGS)), data_path=path)
+
+
+def make_cluster(tmp_path, n_data=2, shards=1, replicas=1, index="docs"):
+    names = ["m0"] + [f"d{i}" for i in range(n_data)]
+    cluster = CrashRestartCluster(names, str(tmp_path),
+                                  roles={"m0": ("master",)})
+    cluster.master().create_index(index, {
+        "settings": {"number_of_shards": shards,
+                     "number_of_replicas": replicas},
+        "mappings": MAPPINGS})
+    return cluster
+
+
+def write_op(doc_id, value):
+    return {"op": "index", "id": doc_id,
+            "source": {"n": value, "body": f"v{value}"}}
+
+
+def node_of_copy(cluster, index, sid, primary):
+    for r in cluster.store.current().shard_copies(index, sid):
+        if r.primary == primary and r.node_id is not None \
+                and r.state == "STARTED":
+            return r.node_id
+    return None
+
+
+def shard_disk_segments(tmp_path, node_name, index="docs", sid=0):
+    return sorted(glob.glob(os.path.join(
+        str(tmp_path), node_name, index, str(sid), "segments", "*.seg")))
+
+
+def corrupt_file(path):
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(path, "wb") as f:
+        f.write(integrity.bitflip(data))
+
+
+# ------------------------------------------------- leg 1: at rest
+
+
+def test_blob_footer_roundtrip_and_legacy_compat():
+    """v3 blobs verify end-to-end; bit flips and truncation raise; v2
+    blobs (no footer) stay readable and are counted, not rejected."""
+    e = make_engine()
+    for i in range(8):
+        e.index(str(i), {"n": i, "body": f"doc {i} hello"})
+    e.refresh()
+    payloads, _ = e.segment_payloads()
+    blob = payloads[0][0]
+    assert blob.startswith(MAGIC)
+    verify_blob(blob)                      # clean: no raise
+    seg = segment_from_blob(blob)
+    assert seg.n_docs == 8
+    assert len(blob_hash(blob)) == 64
+
+    with pytest.raises(SegmentCorruptedError):
+        verify_blob(integrity.bitflip(blob))
+    with pytest.raises(SegmentCorruptedError):
+        segment_from_blob(integrity.bitflip(blob))
+    with pytest.raises(SegmentCorruptedError):
+        verify_blob(blob[:-10])            # truncated footer
+    with pytest.raises(SegmentCorruptedError):
+        verify_blob(b"NOTASEG" + blob)     # bad magic
+
+    # a v2 blob is exactly the v3 body under the old magic, no footer
+    legacy = MAGIC_V2 + blob[len(MAGIC):-32]
+    seg2 = segment_from_blob(legacy)
+    assert seg2.n_docs == 8
+    stats = integrity.integrity_stats()
+    assert stats["legacy_blobs_read"] == 1
+    assert stats["segments_corrupted"] >= 3
+    assert stats["segments_verified"] >= 2
+    assert stats["bytes_verified"] > 0
+
+
+def test_commit_load_verifies_and_writes_marker(tmp_path):
+    """A bit flip in a committed segment fails the reload and drops a
+    corrupted-* marker in the shard data path."""
+    path = str(tmp_path / "shard")
+    e = make_engine(path)
+    for i in range(10):
+        e.index(str(i), {"n": i, "body": f"doc {i}"})
+    e.flush()
+    make_engine(path)                      # clean reload verifies
+    assert integrity.integrity_stats()["segments_verified"] >= 1
+
+    corrupt_file(glob.glob(os.path.join(path, "segments", "*.seg"))[0])
+    with pytest.raises(SegmentCorruptedError):
+        make_engine(path)
+    marker = integrity.corruption_marker(path)
+    assert marker is not None and marker["segment"]
+    assert integrity.integrity_stats()["markers_written"] == 1
+    assert integrity.clear_corruption_markers(path) == 1
+    assert integrity.corruption_marker(path) is None
+
+
+def test_verify_store_catches_rot_under_loaded_engine(tmp_path):
+    """The differential CHECK_ON_STARTUP buys: an engine that loaded
+    cleanly keeps serving from memory after on-disk rot — verify_store
+    (the startup scan) re-reads the store and catches it."""
+    path = str(tmp_path / "shard")
+    e = make_engine(path)
+    for i in range(6):
+        e.index(str(i), {"n": i, "body": f"doc {i}"})
+    e.flush()
+    e2 = make_engine(path)
+    assert e2.verify_store() >= 1          # clean scan
+    corrupt_file(glob.glob(os.path.join(path, "segments", "*.seg"))[0])
+    assert e2.get("3") is not None         # still serves from memory
+    with pytest.raises(SegmentCorruptedError):
+        e2.verify_store()
+    assert integrity.corruption_marker(path) is not None
+
+
+def test_corrupt_primary_store_fails_copy_and_reallocates(tmp_path):
+    """Acceptance: corrupt-on-disk -> shard failed + reallocated from the
+    replica; the corrupted copy is quarantined and re-recovers from the
+    healthy peer; every doc stays readable."""
+    cluster = make_cluster(tmp_path, n_data=2)
+    docs = [f"doc{i}" for i in range(12)]
+    cluster.master().bulk("docs", [write_op(d, 1) for d in docs])
+    victim = node_of_copy(cluster, "docs", 0, primary=True)
+    survivor = node_of_copy(cluster, "docs", 0, primary=False)
+    cluster.primary_instance("docs", docs[0]).engine.flush()
+
+    # report=False: the master still believes the primary is STARTED on
+    # the victim — the corruption is discovered by the restarted node
+    # itself at commit load, not by failure detection
+    cluster.crash(victim, report=False)
+    segs = shard_disk_segments(tmp_path, victim)
+    assert segs
+    corrupt_file(segs[0])
+    cluster.restart(victim)
+
+    stats = integrity.integrity_stats()
+    assert stats["segments_corrupted"] >= 1
+    assert stats["markers_written"] >= 1
+    assert stats["shards_failed_corrupt"] >= 1
+    # the master moved the primary to the healthy peer
+    assert node_of_copy(cluster, "docs", 0, primary=True) == survivor
+    # the corrupt store was moved aside and rebuilt via peer recovery
+    assert stats["copies_quarantined"] >= 1
+    assert os.path.isdir(os.path.join(str(tmp_path), victim, "docs",
+                                      "0.corrupt"))
+    for d in docs:
+        assert cluster.read_doc("docs", d)["n"] == 1
+    # the rebuilt replica is tracked in-sync again
+    inst = cluster.primary_instance("docs", docs[0])
+    assert len(inst.tracker.in_sync_ids) == 2
+    # and the fresh store carries no marker anymore
+    assert integrity.corruption_marker(os.path.join(
+        str(tmp_path), victim, "docs", "0")) is None
+
+
+def test_marker_alone_blocks_primary_reassignment(tmp_path):
+    """A corrupted-* marker must block the store from serving as primary
+    even when the underlying files read back clean — the marker IS the
+    tombstone, not the bit flip."""
+    cluster = make_cluster(tmp_path, n_data=2)
+    docs = [f"doc{i}" for i in range(6)]
+    cluster.master().bulk("docs", [write_op(d, 2) for d in docs])
+    victim = node_of_copy(cluster, "docs", 0, primary=True)
+    survivor = node_of_copy(cluster, "docs", 0, primary=False)
+    cluster.primary_instance("docs", docs[0]).engine.flush()
+    cluster.crash(victim, report=False)
+    # clean files + a marker: a previous incarnation found corruption
+    integrity.write_corruption_marker(
+        os.path.join(str(tmp_path), victim, "docs", "0"),
+        "injected for test")
+    cluster.restart(victim)
+    assert integrity.integrity_stats()["shards_failed_corrupt"] >= 1
+    assert node_of_copy(cluster, "docs", 0, primary=True) == survivor
+    for d in docs:
+        assert cluster.read_doc("docs", d)["n"] == 2
+
+
+# ------------------------------------------------- leg 2: in flight
+
+
+def test_transfer_corruption_retries_then_succeeds(tmp_path):
+    """One injected wire corruption during peer recovery: the target's
+    hash check catches it, the re-fetch is clean, the copy comes up
+    in-sync — counted under transfer_*, not the node-unavailable loop."""
+    cluster = make_cluster(tmp_path, n_data=3)
+    docs = [f"doc{i}" for i in range(10)]
+    cluster.master().bulk("docs", [write_op(d, 3) for d in docs])
+    replica_holder = node_of_copy(cluster, "docs", 0, primary=False)
+    with inject("segment_transfer:raise@1x1"):
+        # the crash triggers reallocation + recovery to the spare node
+        # synchronously; the first segment fetch arrives corrupted
+        cluster.crash(replica_holder)
+    stats = integrity.integrity_stats()
+    assert stats["transfer_corruptions"] == 1
+    assert stats["transfer_retries"] == 1
+    assert stats["transfer_hashes_verified"] >= 1
+    inst = cluster.primary_instance("docs", docs[0])
+    assert len(inst.tracker.in_sync_ids) == 2
+    for d in docs:
+        assert cluster.read_doc("docs", d)["n"] == 3
+
+
+def test_transfer_corruption_exhausts_retries_and_fails(tmp_path,
+                                                        monkeypatch):
+    """Persistent wire corruption: the bounded re-fetch loop gives up with
+    SegmentCorruptedError instead of installing a damaged segment."""
+    monkeypatch.setenv("ES_TPU_RECOVERY_RETRIES", "2")
+    cluster = make_cluster(tmp_path, n_data=2)
+    docs = [f"doc{i}" for i in range(5)]
+    cluster.master().bulk("docs", [write_op(d, 4) for d in docs])
+    primary_holder = node_of_copy(cluster, "docs", 0, primary=True)
+    target = node_of_copy(cluster, "docs", 0, primary=False)
+    svc = cluster.node(target).shard_service
+    with inject("segment_transfer:raise@1x99"):
+        with pytest.raises(SegmentCorruptedError):
+            svc._fetch_verified_segments(
+                primary_holder, {"index": "docs", "shard_id": 0})
+    stats = integrity.integrity_stats()
+    assert stats["transfer_corruptions"] == 3      # initial + 2 retries
+    assert stats["transfer_retries"] == 2
+
+
+# ------------------------------------------------- leg 3: in HBM
+
+
+class _Seg:
+    def __init__(self, n_docs, fp):
+        self.n_docs = n_docs
+        self.postings = {"body": fp}
+        self.vectors = {}
+
+
+def _corpus(n_docs=1500, vocab=120, seed=7):
+    from elasticsearch_tpu.index.segment import build_field_postings
+
+    rng = np.random.default_rng(seed)
+    probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+    probs /= probs.sum()
+    lens = rng.integers(4, 20, size=n_docs).astype(np.int64)
+    tokens = rng.choice(vocab, size=int(lens.sum()),
+                        p=probs).astype(np.int64)
+    tok_docs = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+    fp = build_field_postings("body", lens, tok_docs, tokens,
+                              [f"t{i}" for i in range(vocab)])
+    return fp, n_docs
+
+
+def _make_turbo():
+    from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+    from elasticsearch_tpu.parallel.turbo import TurboBM25
+
+    fp, n_docs = _corpus()
+    stacked = build_stacked_bm25([_Seg(n_docs, fp)], "body",
+                                 serve_only=True)
+    return TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=10)
+
+
+def _scrub_full_cycle():
+    out = []
+    for _ in range(integrity.scrub_registry_size()):
+        out.append(integrity.scrub_once())
+    return out
+
+
+def test_hbm_scrub_detects_and_repairs_injected_flip():
+    """Acceptance: an injected hbm_region flip on a host-backed region is
+    detected by the scrubber and repaired bit-identically from the host
+    fingerprint copy; scrub-on vs scrub-off results are identical."""
+    control = _make_turbo()
+    integrity.reset_scrub_for_tests()      # only the scrubbed engine below
+    turbo = _make_turbo()
+    assert integrity.scrub_registry_size() >= 5
+
+    queries = [[("t1", 1.0), ("t3", 1.0)], [("t2", 2.0)],
+               [("t5", 1.0), ("t9", 1.0), ("t1", 1.0)]]
+    want_s, want_d = control.search(queries, k=10)
+
+    _scrub_full_cycle()                    # baseline pass: all clean
+    st = integrity.integrity_stats()
+    assert st["scrub_mismatches"] == 0 and st["scrub_clean"] >= 3
+
+    with inject("hbm_region#lane_docs:raise@1x1"):
+        results = _scrub_full_cycle()
+    hit = [r for r in results if r and r["result"] == "mismatch"]
+    assert len(hit) == 1 and hit[0]["region"].endswith(".lane_docs")
+    st = integrity.integrity_stats()
+    assert st["scrub_mismatches"] == 1
+    assert st["scrub_repairs"] == 1
+    assert st["scrub_repaired_bytes"] > 0
+
+    # the repaired engine answers bit-identically to the never-scrubbed one
+    got_s, got_d = turbo.search(queries, k=10)
+    assert np.array_equal(np.asarray(want_d), np.asarray(got_d))
+    assert np.array_equal(np.asarray(want_s), np.asarray(got_s))
+    # and the next full cycle is clean again
+    _scrub_full_cycle()
+    assert integrity.integrity_stats()["scrub_mismatches"] == 1
+
+
+def test_hbm_scrub_repairs_real_device_corruption():
+    """No injection: overwrite the device-resident live mask with flipped
+    bits directly — the scrubber restores it from the host copy."""
+    import jax.numpy as jnp
+
+    turbo = _make_turbo()
+    good = np.asarray(turbo.live).copy()
+    bad = np.frombuffer(
+        integrity.bitflip(good.tobytes()), good.dtype).reshape(good.shape)
+    turbo.live = jnp.asarray(bad)
+    for _ in range(integrity.scrub_registry_size() * 2):
+        integrity.scrub_once()
+    assert integrity.integrity_stats()["scrub_repairs"] >= 1
+    assert np.array_equal(np.asarray(turbo.live), good)
+
+
+def test_scrub_baseline_regions_track_legitimate_updates():
+    """Baseline (epoch) regions: a legitimate functional rebuild rebinds
+    the array -> new epoch -> re-baseline, NOT a mismatch."""
+    turbo = _make_turbo()
+    # warm the column cache so cols_hi holds data, then scrub twice
+    turbo.search([[("t1", 1.0)]], k=5)
+    for _ in range(integrity.scrub_registry_size() * 2):
+        integrity.scrub_once()
+    before = integrity.integrity_stats()["scrub_mismatches"]
+    # more searches may admit new columns (rebinding cols_hi/cols_lo)
+    turbo.search([[("t2", 1.0), ("t4", 1.0)]], k=5)
+    for _ in range(integrity.scrub_registry_size() * 2):
+        integrity.scrub_once()
+    st = integrity.integrity_stats()
+    assert st["scrub_mismatches"] == before      # no false positives
+    assert st["scrub_baselined"] >= 1
+
+
+def test_scrub_region_registration_validation():
+    class Owner:
+        pass
+
+    o = Owner()
+    with pytest.raises(ValueError):
+        integrity.register_scrub_region(o, "r", lambda x: None)
+    with pytest.raises(ValueError):
+        integrity.register_scrub_region(o, "r", lambda x: None,
+                                        expected=lambda x: None,
+                                        epoch=lambda x: 1)
+
+
+def test_scrubber_lifecycle_and_overload_yield(monkeypatch):
+    """start() is a no-op with the knob at 0; a non-GREEN overload level
+    skips the tick (counted) without touching any region."""
+    from elasticsearch_tpu.common.integrity import IntegrityScrubber
+
+    assert IntegrityScrubber().start() is False   # knob defaults to 0
+
+    class _Overload:
+        def __init__(self, level):
+            self._level = level
+
+        def stats(self):
+            return {"level": self._level}
+
+    s = IntegrityScrubber(overload=_Overload("red"))
+    s.tick()
+    assert integrity.integrity_stats()["scrub_yields"] == 1
+    s2 = IntegrityScrubber(overload=_Overload("green"))
+    s2.tick()                                     # empty registry: no-op
+    assert integrity.integrity_stats()["scrub_ticks"] == 0
+    assert integrity.scrub_once() is None         # nothing registered
+
+    monkeypatch.setenv("ES_TPU_INTEGRITY_SCRUB_S", "30")
+    s3 = IntegrityScrubber()
+    assert s3.start() is True
+    s3.stop()
+
+
+# ------------------------------------------------- startup checks
+
+
+def test_check_on_startup_catches_corruption_before_started(tmp_path,
+                                                            monkeypatch):
+    """Acceptance: with ES_TPU_CHECK_ON_STARTUP the post-recovery store
+    scan catches a segment_read corruption BEFORE the copy reports
+    started; the master re-runs recovery and the copy lands healthy."""
+    monkeypatch.setenv("ES_TPU_CHECK_ON_STARTUP", "1")
+    cluster = make_cluster(tmp_path, n_data=3)
+    docs = [f"doc{i}" for i in range(8)]
+    cluster.master().bulk("docs", [write_op(d, 5) for d in docs])
+    replica_holder = node_of_copy(cluster, "docs", 0, primary=False)
+    with inject("segment_read:raise@1x1"):
+        # reallocation + recovery to the spare node runs synchronously;
+        # the startup scan's first blob read comes back flipped
+        cluster.crash(replica_holder)
+    stats = integrity.integrity_stats()
+    assert stats["startup_checks"] >= 1
+    assert stats["startup_failures"] == 1
+    assert stats["shards_failed_corrupt"] >= 1
+    # the retried recovery (injection exhausted) brought the copy up
+    inst = cluster.primary_instance("docs", docs[0])
+    assert len(inst.tracker.in_sync_ids) == 2
+    for d in docs:
+        assert cluster.read_doc("docs", d)["n"] == 5
+
+
+def test_check_on_startup_off_skips_scan(tmp_path, monkeypatch):
+    """Differential: with the knob OFF the same injection is never
+    consulted — no scan, no failure, the copy starts immediately."""
+    monkeypatch.delenv("ES_TPU_CHECK_ON_STARTUP", raising=False)
+    cluster = make_cluster(tmp_path, n_data=3)
+    docs = [f"doc{i}" for i in range(8)]
+    cluster.master().bulk("docs", [write_op(d, 6) for d in docs])
+    replica_holder = node_of_copy(cluster, "docs", 0, primary=False)
+    with inject("segment_read:raise@1x1"):
+        cluster.crash(replica_holder)
+    stats = integrity.integrity_stats()
+    assert stats["startup_checks"] == 0
+    assert stats["startup_failures"] == 0
+    inst = cluster.primary_instance("docs", docs[0])
+    assert len(inst.tracker.in_sync_ids) == 2
+
+
+# ------------------------------------------------- surfaces
+
+
+def test_integrity_stats_section_shape():
+    from elasticsearch_tpu.rest.handlers import _tpu_integrity_stats
+
+    out = _tpu_integrity_stats()
+    for key in ("segments_verified", "segments_corrupted",
+                "markers_written", "shards_failed_corrupt",
+                "copies_quarantined", "transfer_corruptions",
+                "transfer_retries", "scrub_ticks", "scrub_mismatches",
+                "scrub_repairs", "scrub_yields", "repo_verifies",
+                "repo_corrupt_blobs", "restore_cleanups",
+                "scrub_regions"):
+        assert key in out, key
+
+
+def test_corruption_fault_sites_registered():
+    from elasticsearch_tpu.common.faults import (
+        CORRUPTION_SITES, KNOWN_SITES, parse_spec,
+    )
+
+    assert CORRUPTION_SITES <= KNOWN_SITES
+    for site in ("segment_read", "segment_transfer", "hbm_region"):
+        clause = parse_spec(f"{site}#p1:raise@1")[0]
+        assert clause.part == "p1"
