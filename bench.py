@@ -802,8 +802,10 @@ def main():
             t0 = time.time()
             cpu_bool = [cpu.search_bool(q) for q in bool_qs[:n_cpu]]
             cpu_bool_qps = n_cpu / (time.time() - t0)
+            from elasticsearch_tpu.common.settings import knob
             c2 = {
                 "engine": bmx2.kind,
+                "bitset": bool(knob("ES_TPU_BITSET")),
                 "qps": round(QUERIES / bool_wall, 1),
                 "cpu_qps": round(cpu_bool_qps, 1),
                 "vs_cpu": round(QUERIES / bool_wall / cpu_bool_qps, 2),
@@ -955,6 +957,104 @@ def dryrun_faults() -> int:
     }), flush=True)
     log(f"dryrun_faults: identical={identical} "
         f"device_faults={st.get('health_device_faults', 0)}")
+    return 0 if ok else 1
+
+
+def dryrun_bitset() -> int:
+    """Bitset-engine dry-run (PR 16): 2-partition fused engine on the
+    virtual CPU mesh, a config2-shaped bool mix through the packed-uint32
+    intersection path, asserting (a) top-10 bit-identity with
+    search_bool_host, (b) nonzero skipped-block counters (the sweep
+    actually pruned all-zero chunks), (c) zero retraces once the shapes
+    are primed via extend_qc_sizes, and (d) ledger == engine HBM bytes
+    with the bitset regions packed. One JSON line on stdout; exit 0/1."""
+    os.environ.setdefault("ES_TPU_FORCE_TURBO", "1")
+    os.environ["ES_TPU_BITSET"] = "1"
+    os.environ["ES_TPU_BITSET_HOST_DF"] = "0"   # pure device path
+    if os.environ.get("TEST_ON_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.common import hbm_ledger
+    from elasticsearch_tpu.index.segment import build_field_postings
+    from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+    from elasticsearch_tpu.parallel.turbo import TurboBM25
+    from elasticsearch_tpu.search.serving import TurboEngine, _turbo_mesh
+
+    def part(n_docs, vocab, seed):
+        rng = np.random.default_rng(seed)
+        probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+        probs /= probs.sum()
+        lens = rng.integers(4, 24, size=n_docs).astype(np.int64)
+        tokens = rng.choice(vocab, size=int(lens.sum()),
+                            p=probs).astype(np.int64)
+        tok_docs = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+        fp = build_field_postings(
+            "body", lens, tok_docs, tokens,
+            [f"t{i}" for i in range(vocab)])
+        stacked = build_stacked_bm25([_Seg(n_docs, fp)], "body",
+                                     serve_only=True)
+        return TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=5)
+
+    log("dryrun_bitset: building 2-partition fused engine...")
+    eng = TurboEngine([part(2600, 40, 1), part(1800, 32, 2)],
+                      mesh=_turbo_mesh(2))
+    # config2-shaped mix: selective mid-freq musts, heavy head-term
+    # conjunctions, filters and must_nots
+    rng = np.random.default_rng(7)
+    specs = []
+    for i in range(12):
+        h = rng.integers(0, 4, size=2)
+        m = rng.integers(8, 28, size=2)
+        if i % 2 == 0:
+            specs.append({"must": [(f"t{m[0]}", 1.0)],
+                          "should": [(f"t{h[0]}", 1.0)],
+                          "filter": [f"t{m[1]}"] if i % 4 == 0 else []})
+        else:
+            specs.append({"must": [(f"t{h[0]}", 1.0), (f"t{h[1]}", 1.0)],
+                          "should": [(f"t{m[0]}", 1.0)],
+                          "must_not": [f"t{m[1]}"] if i % 3 == 0 else []})
+    k = 10
+    # prime every shape the dispatch will take, then warm up: the second
+    # pass must not trace anything new
+    eng.extend_qc_sizes([len(specs)])
+    eng._fused()
+    eng.extend_qc_sizes([len(specs)])   # fused dispatcher too (lazy init)
+    eng.search_bool(specs, k=k)
+    r0 = hbm_ledger.compile_stats()["retraces"]
+    got = eng.search_bool(specs, k=k)
+    retraces = hbm_ledger.compile_stats()["retraces"] - r0
+    want = eng._merge3([t.search_bool_host(specs, k=k)
+                        for t in eng.turbos], len(specs), k)
+    identical = all(np.array_equal(np.asarray(g), np.asarray(w))
+                    for g, w in zip(got, want))
+    agreement10 = 1.0 if identical else 0.0
+    st = eng.stats
+    skipped = int(st.get("bitset_blocks_skipped", 0))
+    packs = int(st.get("bitset_packs", 0))
+    ledger_ok = all(t._hbm.total_bytes() == t.hbm_bytes()
+                    for t in eng.turbos)
+    fused = eng._fused()
+    ledger_ok = ledger_ok and fused._hbm.total_bytes() == fused.hbm_bytes()
+    ok = (identical and skipped > 0 and packs >= 2 and retraces == 0
+          and ledger_ok)
+    print(json.dumps({
+        "metric": "dryrun_bitset",
+        "ok": bool(ok),
+        "top10_agreement": agreement10,
+        "bitset_blocks_skipped": skipped,
+        "bitset_packs": packs,
+        "bitset_bytes": int(st.get("bitset_bytes", 0)),
+        "retraces": int(retraces),
+        "ledger_matches_engine": bool(ledger_ok),
+    }), flush=True)
+    log(f"dryrun_bitset: identical={identical} skipped={skipped} "
+        f"retraces={retraces} ledger_ok={ledger_ok}")
     return 0 if ok else 1
 
 
@@ -1836,6 +1936,9 @@ if __name__ == "__main__":
     if "dryrun_faults" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_faults":
         sys.exit(dryrun_faults())
+    if "dryrun_bitset" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_bitset":
+        sys.exit(dryrun_bitset())
     if "dryrun_disruption" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_disruption":
         sys.exit(dryrun_disruption())

@@ -90,6 +90,7 @@ KNOWN_SITES = frozenset({
     "fused_dispatch",    # ShardedTurbo fused S>1 shard_map dispatch
     "merge_kernel",      # device-side partition top-k merge
     "column_upload",     # int8 column build/refresh onto the device
+    "bitset_intersect",  # packed-uint32 bool match-set pack/intersect
     "blockmax_pass",     # BlockMax engine device pass
 }) | TRANSPORT_SITES | DURABILITY_SITES | OVERLOAD_SITES | CORRUPTION_SITES
 

@@ -430,6 +430,9 @@ declare_histogram("coalesce_pad_ratio", "ratio", "fraction of a padded device ba
 # observe_if_declared(f"sched_tier_wait.{tier}"), one per SLA tier.
 declare_histogram("sched_bucket_size", "count", "bucket (padded batch shape) chosen per adaptive-scheduler flush")
 declare_histogram("sched_queue_depth", "count", "lane queue depth at each adaptive-scheduler flush")
+# device bitset intersection for bool queries (PR 16)
+declare_histogram("bitset_blocks_skipped", "count", "2048-doc chunks skipped (all-zero intersected match set) per bool query dispatch")
+declare_histogram("bitset_block_occupancy", "ratio", "fraction of 2048-doc chunks with surviving docs after clause intersection, per bool query")
 declare_histogram("sched_tier_wait.interactive", "ms", "scheduler wait, interactive tier (enqueue -> batch results ready)")
 declare_histogram("sched_tier_wait.bulk", "ms", "scheduler wait, bulk tier (enqueue -> batch results ready)")
 # cluster task plane (PR 11); task_duration.* names are composed

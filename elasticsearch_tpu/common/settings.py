@@ -318,6 +318,14 @@ declare_knob("ES_TPU_TURBO_MESH", "int", None,
 declare_knob("ES_TPU_FORCE_TURBO", "flag", False,
              "'1' forces Turbo eligibility off-TPU (interpret-mode "
              "differential tests)")
+declare_knob("ES_TPU_BITSET", "flag", True,
+             "Packed-uint32 bitset intersection for bool queries: clause "
+             "match sets AND/AND-NOT blockwise on device and the sweep "
+             "skips all-zero blocks (0 = dense coverage-matmul sweep)")
+declare_knob("ES_TPU_BITSET_HOST_DF", "int", 512,
+             "Bool queries whose rarest required clause has df below this "
+             "route to the galloping host intersection instead of the "
+             "device bitset sweep (0 disables the fallback)")
 declare_knob("ES_TPU_DISABLE_SHARD_SERVING", "flag", False,
              "'1' disables the shard-level serving fast path on data nodes")
 declare_knob("ES_TPU_SEARCH_SHARD_RETRIES", "int", 3,
@@ -397,7 +405,9 @@ declare_knob("ES_TPU_SCHED_MODE", "str", "adaptive",
              "scheduler) or 'legacy' (fixed-window coalescer)")
 declare_knob("ES_TPU_SCHED_BUCKETS", "str", "1,4,16,64,256",
              "Padded batch-size ladder for the adaptive scheduler "
-             "(comma-separated, each bucket is one compiled shape)")
+             "(comma-separated, each bucket is one compiled shape); when "
+             "the env var is unset the ladder autotunes from the observed "
+             "sched_queue_depth / coalesce_pad_ratio histograms")
 declare_knob("ES_TPU_SCHED_INTERACTIVE_US", "float", 1000.0,
              "Max scheduler queue wait for interactive-tier queries, "
              "microseconds")

@@ -312,6 +312,266 @@ ROWS_PER_STEP = 8
 
 
 # --------------------------------------------------------------------------
+# packed-bitset conjunction kernels
+# --------------------------------------------------------------------------
+#
+# The coverage-matmul conjunction above multiplies a dense presence matrix
+# over the FULL doc axis for every bool query — config2's 4.7x-CPU wall.
+# The bitset engine replaces it with the classic packed match-set
+# representation (ref: SIMD intersection of sorted integers, PAPERS.md):
+# every column slot's presence packs 32 posting rows per uint32 lane word,
+# clause intersection is blockwise AND / AND-NOT over those words, and the
+# score sweep only runs its four MXU matmuls on 2048-doc chunks whose
+# intersected mask still has a surviving bit — empty chunks cost one
+# 16-lane-word test instead of four matmuls.
+
+SW_WORD_ROWS = SW_ROWS // 32   # 16 uint32 word rows per superwindow
+BITSET_CLAUSES = 8             # AND fan-in per intersect step (rarest-df
+#                                clauses win; extras leave the mask a
+#                                SUPERSET — the exact host rescore drops
+#                                spurious survivors, so top-k is unchanged)
+BITSET_NEGS = 4                # AND-NOT fan-in (largest-df prohibitions)
+
+
+@jax.jit
+def pack_presence_bits(cols_hi, cols_lo):
+    """Pack the column cache's presence into per-slot doc bitsets.
+
+    cols_hi/cols_lo [dp_chunks, Hp+1, 16, 128] i8 — the serving layout.
+    Presence is EXACT by the build kernel's lo >= 1 forcing, so
+    (hi | lo) != 0 is the true match set of each colized term.
+
+    Returns bits [Hp+2, dp_rows // 32, 128] u32: bit j of word
+    [s, g, l] is slot s's presence at posting row 32g + j, lane l
+    (doc = (32g + j) * 128 + l; one word row = two sweep chunks). Two
+    sentinel slots ride along: slot Hp (the build scratch slot, always
+    zero) is the AND-NOT identity and the empty mask for inactive query
+    rows; appended slot Hp+1 is all-ones, the AND identity padding for
+    active queries with fewer than BITSET_CLAUSES required clauses.
+    """
+    dpc, hp1 = cols_hi.shape[0], cols_hi.shape[1]
+    p = (cols_hi != 0) | (cols_lo != 0)           # [dpc, Hp+1, 16, 128]
+    p = jnp.transpose(p, (1, 0, 2, 3)).reshape(hp1, dpc // 2, 32, 128)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, None, :, None]
+    w = jnp.sum(p.astype(jnp.uint32) << shifts, axis=2)
+    ones = jnp.full((1, dpc // 2, 128), 0xFFFFFFFF, jnp.uint32)
+    return jnp.concatenate([w, ones], axis=0)
+
+
+def _intersect_kernel():
+    def kernel(q_slots, q_neg, *refs):
+        pos = refs[:BITSET_CLAUSES]
+        neg = refs[BITSET_CLAUSES:BITSET_CLAUSES + BITSET_NEGS]
+        out = refs[BITSET_CLAUSES + BITSET_NEGS]
+        acc = pos[0][0]                           # [SW_WORD_ROWS, 128] u32
+        for r in pos[1:]:
+            acc = acc & r[0]
+        for r in neg:
+            acc = acc & ~r[0]
+        out[0] = acc
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("QC", "nsw"))
+def intersect_bitset(q_slots, q_neg, bits, *, QC: int, nsw: int):
+    """Blockwise clause intersection over the packed bitsets.
+
+    q_slots [QC, BITSET_CLAUSES] i32 — bits slot per required clause
+        (pad with a repeated clause or the all-ones sentinel; an
+        inactive query row pads every clause with the all-zero sentinel
+        so its mask is empty and every chunk skips)
+    q_neg [QC, BITSET_NEGS] i32 — slot per must_not clause (pad with the
+        all-zero sentinel, the AND-NOT identity)
+    bits [Hp+2, nsw * SW_WORD_ROWS, 128] u32 — pack_presence_bits output
+
+    The grid gathers each clause's superwindow block straight out of the
+    bits array via scalar-prefetch indexed BlockSpecs (the build_columns
+    idiom), so the kernel body is BITSET_CLAUSES - 1 ANDs and
+    BITSET_NEGS AND-NOTs per block — pure VPU, no matmul.
+    Returns mask [QC, nsw * SW_WORD_ROWS, 128] u32.
+    """
+    wgr = nsw * SW_WORD_ROWS
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(QC, nsw),
+        in_specs=(
+            [pl.BlockSpec((1, SW_WORD_ROWS, 128),
+                          (lambda q, b, qs, qn, c=c: (qs[q, c], b, 0)),
+                          memory_space=pltpu.VMEM)
+             for c in range(BITSET_CLAUSES)]
+            + [pl.BlockSpec((1, SW_WORD_ROWS, 128),
+                            (lambda q, b, qs, qn, n=n: (qn[q, n], b, 0)),
+                            memory_space=pltpu.VMEM)
+               for n in range(BITSET_NEGS)]),
+        out_specs=pl.BlockSpec((1, SW_WORD_ROWS, 128),
+                               lambda q, b, qs, qn: (q, b, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    fn = pl.pallas_call(
+        _intersect_kernel(),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((QC, wgr, 128), jnp.uint32),
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )
+    return fn(q_slots, q_neg,
+              *([bits] * (BITSET_CLAUSES + BITSET_NEGS)))
+
+
+@jax.jit
+def mask_chunk_counts(mask):
+    """Per-query count of 2048-doc chunks with any surviving bit —
+    the skipped-block telemetry source (total chunks minus this).
+
+    mask [QC, wgr, 128] u32; each word row g holds chunks 2g (low 16
+    bits) and 2g + 1 (high 16). Returns [QC] i32.
+    """
+    lo = jnp.any((mask & jnp.uint32(0xFFFF)) != 0, axis=-1)
+    hi = jnp.any((mask >> jnp.uint32(16)) != 0, axis=-1)
+    return (jnp.sum(lo, axis=-1) + jnp.sum(hi, axis=-1)).astype(jnp.int32)
+
+
+def _sweep_bitset_kernel(QC: int, Hpt: int):
+    def kernel(qscale, hi_blk, lo_blk, wq, mask_blk, live_blk,
+               out_m, out_r, acc_rm):
+        c = pl.program_id(1)
+        sw = pl.program_id(0)
+
+        # expand this chunk's 16-bit half of the intersected word row
+        w = mask_blk[...][:, 0, :]                        # [QC, 128] u32
+        shifts = (jax.lax.broadcasted_iota(
+            jnp.int32, (1, CHUNK_ROWS, 1), 1)
+            + (c % 2) * CHUNK_ROWS).astype(jnp.uint32)
+        alive = (jnp.right_shift(w[:, None, :], shifts)
+                 & jnp.uint32(1)) != 0                    # [QC, 16, 128]
+        nz = jnp.any(alive)
+
+        @pl.when(nz)
+        def _score():
+            wh = wq[0]                                    # [QC, Hpt] i8
+            wl = wq[1]
+            ch = hi_blk[0]                                # [Hpt, 16, 128]
+            cl = lo_blk[0]
+            dn = (((1,), (0,)), ((), ()))
+            m_hh = jax.lax.dot_general(wh, ch, dn,
+                                       preferred_element_type=jnp.int32)
+            m_hl = jax.lax.dot_general(wh, cl, dn,
+                                       preferred_element_type=jnp.int32)
+            m_lh = jax.lax.dot_general(wl, ch, dn,
+                                       preferred_element_type=jnp.int32)
+            m_ll = jax.lax.dot_general(wl, cl, dn,
+                                       preferred_element_type=jnp.int32)
+            val = (16384.0 * m_hh.astype(jnp.float32)
+                   + 128.0 * (m_hl + m_lh).astype(jnp.float32)
+                   + m_ll.astype(jnp.float32))            # [QC, 16, 128]
+            val = val * qscale[...][:, :, None]
+            lv = live_blk[...]                            # [16, 128] f32
+            val = jnp.where((lv[None] > 0) & (val > 0) & alive,
+                            val, -jnp.inf)
+            acc_rm[pl.ds(c, 1), :, :] = jnp.transpose(
+                jnp.max(val, axis=2))[None]
+
+        @pl.when(jnp.logical_not(nz))
+        def _skip():
+            # the scratch row is reused across superwindows — a skipped
+            # chunk must still overwrite last round's values
+            acc_rm[pl.ds(c, 1), :, :] = jnp.full(
+                (1, CHUNK_ROWS, QC), -jnp.inf, jnp.float32)
+
+        @pl.when(c == N_CHUNKS - 1)
+        def _toprows():
+            rm = acc_rm[...]                              # [32, 16, QC]
+            rows3 = (jax.lax.broadcasted_iota(
+                        jnp.int32, (N_CHUNKS, CHUNK_ROWS, QC), 0)
+                     * CHUNK_ROWS
+                     + jax.lax.broadcasted_iota(
+                        jnp.int32, (N_CHUNKS, CHUNK_ROWS, QC), 1))
+            big = jnp.int32(1 << 30)
+            cand_iota = jax.lax.broadcasted_iota(
+                jnp.int32, (CAND_PAD, QC), 0)
+            all_m = jnp.full((CAND_PAD, QC), -jnp.inf, jnp.float32)
+            all_r = jnp.zeros((CAND_PAD, QC), jnp.int32)
+            for p in range(NCAND):
+                m2 = jnp.max(jnp.max(rm, axis=0), axis=0,
+                             keepdims=True)               # [1, QC]
+                at = rm == m2[None]
+                rmin = jnp.min(jnp.min(jnp.where(at, rows3, big), axis=0),
+                               axis=0, keepdims=True)     # [1, QC]
+                keep = (cand_iota == p) & (m2 > -jnp.inf)
+                all_m = jnp.where(keep, m2, all_m)
+                all_r = jnp.where(keep, rmin + sw * SW_ROWS, all_r)
+                rm = jnp.where(rows3 == rmin[None], -jnp.inf, rm)
+            out_m[0, :, :] = jnp.transpose(all_m)
+            out_r[0, :, :] = jnp.transpose(all_r)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("QC", "nsw"))
+def sweep_rowmax_bitset(qscale, cols_hi, cols_lo, wq, mask, live,
+                        *, QC: int, nsw: int):
+    """Bitset variant of sweep_rowmax_conj: the intersected match-set
+    mask (intersect_bitset output) replaces the per-chunk coverage
+    matmul, and chunks whose mask half-word is all-zero skip the four
+    score matmuls entirely — a selective lead term turns the full-cache
+    sweep into a sparse one.
+
+    mask [QC, nsw * SW_WORD_ROWS, 128] u32 — chunk c of superwindow sw
+    reads word row sw * SW_WORD_ROWS + c // 2, bit half c % 2.
+    Returns the same (rowmax, rows) pair as sweep_rowmax_conj; the mask
+    is a superset of the true match set when a query carries more than
+    BITSET_CLAUSES / BITSET_NEGS clauses, so the caller's exact rescore
+    (which re-tests every clause) remains the source of truth.
+    """
+    Hpt = cols_hi.shape[1]
+    kernel = _sweep_bitset_kernel(QC, Hpt)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(nsw, N_CHUNKS),
+        in_specs=[
+            pl.BlockSpec((QC, 1), lambda sw, c: (0, 0),
+                         memory_space=pltpu.VMEM),        # qscale
+            pl.BlockSpec((1, Hpt, CHUNK_ROWS, 128),
+                         lambda sw, c: (sw * N_CHUNKS + c, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Hpt, CHUNK_ROWS, 128),
+                         lambda sw, c: (sw * N_CHUNKS + c, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),        # wq
+            pl.BlockSpec((QC, 1, 128),
+                         lambda sw, c: (0, sw * SW_WORD_ROWS + c // 2, 0),
+                         memory_space=pltpu.VMEM),        # mask word row
+            pl.BlockSpec((CHUNK_ROWS, 128),
+                         lambda sw, c: (sw * N_CHUNKS + c, 0),
+                         memory_space=pltpu.VMEM),        # live chunk
+        ],
+        out_specs=[
+            pl.BlockSpec((1, QC, CAND_PAD), lambda sw, c: (sw, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, QC, CAND_PAD), lambda sw, c: (sw, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((N_CHUNKS, CHUNK_ROWS, QC), jnp.float32),  # acc_rm
+        ],
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((nsw, QC, CAND_PAD), jnp.float32),
+            jax.ShapeDtypeStruct((nsw, QC, CAND_PAD), jnp.int32),
+        ],
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )
+    return fn(qscale, cols_hi, cols_lo, wq, mask, live)
+
+
+# --------------------------------------------------------------------------
 # partition-merge kernel
 # --------------------------------------------------------------------------
 

@@ -39,6 +39,7 @@ host verification, not branchy skipping.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -48,17 +49,22 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as _P
 
-from elasticsearch_tpu.common import faults, hbm_ledger, integrity, tracing
+from elasticsearch_tpu.common import (
+    faults, hbm_ledger, integrity, metrics, tracing,
+)
 from elasticsearch_tpu.common.errors import DeviceFaultError
 from elasticsearch_tpu.common.faults import FaultRecord
+from elasticsearch_tpu.common.settings import knob
 from elasticsearch_tpu.index.positions import phrase_freqs
 from elasticsearch_tpu.index.segment import tf_at
 from elasticsearch_tpu.ops import bm25_idf
 from elasticsearch_tpu.parallel.blockmax import _host_block_scores
 from elasticsearch_tpu.parallel.compat import shard_map as _shard_map
 from elasticsearch_tpu.parallel.kernels import (
-    COLSCALE, COLSCALE2, MAX_GROUP_ROWS, NCAND, ROWS_PER_STEP,
-    SW, TILE, build_columns, sweep_rowmax, sweep_rowmax_conj,
+    BITSET_CLAUSES, BITSET_NEGS, COLSCALE, COLSCALE2, MAX_GROUP_ROWS,
+    N_CHUNKS, NCAND, ROWS_PER_STEP, SW, SW_WORD_ROWS, TILE, build_columns,
+    intersect_bitset, mask_chunk_counts, pack_presence_bits, sweep_rowmax,
+    sweep_rowmax_bitset, sweep_rowmax_conj,
 )
 from elasticsearch_tpu.parallel.spmd import StackedBM25
 
@@ -180,6 +186,23 @@ def _pkey(terms: Sequence[str]) -> str:
     return "\x00p:" + "\x00".join(terms)
 
 
+def _intersect_sorted(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sorted-unique intersection with a galloping gear: when one side is
+    tiny relative to the other (the ultra-selective-lead regime that
+    ES_TPU_BITSET_HOST_DF routes to host), binary-searching the small
+    side's members in the large one (s * log2(b) work) beats np.isin's
+    linear merge over both."""
+    if len(a) > len(b):
+        a, b = b, a
+    if not len(a):
+        return a
+    if len(a) * max(np.log2(len(b)), 1.0) < len(b):
+        j = np.searchsorted(b, a)
+        jc = np.minimum(j, len(b) - 1)
+        return a[(j < len(b)) & (b[jc] == a)]
+    return a[np.isin(a, b, assume_unique=True)]
+
+
 @dataclass
 class _BoolQuery:
     """One resolved bool query (TurboBM25.search_bool). Clause lists keep
@@ -192,6 +215,29 @@ class _BoolQuery:
     must_not: list    # [(term, _TermInfo)] — prohibited
     phrases: list     # [(terms, slop, boost, _PhraseInfo | None, idf_sum)]
     dev_candidate: bool
+
+
+# node-wide bitset counters mirrored from every engine's per-instance
+# stats so GET /_nodes/stats tpu_turbo surfaces them next to the merge
+# counters (serving.turbo_node_stats folds these in); bitset_bytes is a
+# gauge-like running total of currently packed bytes (repacks add the
+# delta), the rest are cumulative counters
+_NODE_BITSET_STATS = {"bitset_packs": 0, "bitset_bytes": 0,
+                      "bitset_blocks_skipped": 0,
+                      "bitset_gallop": 0}  # guarded by: _NODE_BITSET_LOCK
+_NODE_BITSET_LOCK = threading.Lock()
+
+
+def _node_bitset_add(key: str, n: int) -> None:
+    if n == 0:
+        return
+    with _NODE_BITSET_LOCK:
+        _NODE_BITSET_STATS[key] += n
+
+
+def node_bitset_stats() -> dict:
+    with _NODE_BITSET_LOCK:
+        return dict(_NODE_BITSET_STATS)
 
 
 class TurboBM25:
@@ -291,9 +337,16 @@ class TurboBM25:
         # multi-partition cache (ShardedTurbo._refresh) re-syncs only the
         # partitions whose columns actually changed
         self.cols_epoch = 0
+        # packed-uint32 per-slot match-set bitsets (ES_TPU_BITSET): built
+        # lazily from the column cache on the first bool dispatch and
+        # re-packed whenever cols_epoch moves
+        self.bits = None
+        self._bits_epoch = -1
         self.stats = {"builds": 0, "build_s": 0.0, "fallbacks": 0,
                       "cold_queries": 0, "dispatches": 0, "degraded": 0,
-                      "phrase_builds": 0, "bool_host": 0, "bool_device": 0}
+                      "phrase_builds": 0, "bool_host": 0, "bool_device": 0,
+                      "bitset_packs": 0, "bitset_gallop": 0,
+                      "bitset_blocks_skipped": 0, "bitset_bytes": 0}
         # HBM residency ledger: regions mirror hbm_bytes() exactly so the
         # telemetry cross-check can hold ledger == engine to the byte
         self._hbm = hbm_ledger.register_engine(self, "turbo")
@@ -303,6 +356,8 @@ class TurboBM25:
     def _register_hbm_regions(self) -> None:
         self._hbm.set_region("cols_hi", self.cols_hi.nbytes)
         self._hbm.set_region("cols_lo", self.cols_lo.nbytes)
+        self._hbm.set_region("cols_bits",
+                             0 if self.bits is None else self.bits.nbytes)
         self._hbm.set_region("lane_docs", self.lane_docs.nbytes)
         self._hbm.set_region("lane_scores", self.lane_scores.nbytes)
         self._hbm.set_region("live", self.live.nbytes)
@@ -337,6 +392,7 @@ class TurboBM25:
 
     def hbm_bytes(self) -> int:
         return (self.cols_hi.nbytes + self.cols_lo.nbytes
+                + (0 if self.bits is None else self.bits.nbytes)
                 + self.lane_docs.nbytes + self.lane_scores.nbytes
                 + self.live.nbytes)
 
@@ -374,6 +430,7 @@ class TurboBM25:
             for s in sizes)
         self.qc_sizes = tuple(sorted(merged))
         hbm_ledger.note_primed("turbo", self.qc_sizes)
+        hbm_ledger.note_primed("turbo_bitset", self.qc_sizes)
 
     # ---------------- column cache ----------------
 
@@ -1198,6 +1255,154 @@ class TurboBM25:
                 self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
                 QC=QC, nsw=self.nsw)
 
+    # ---------------- packed-bitset engine (ES_TPU_BITSET) ----------------
+
+    def _repack_bits(self) -> None:
+        """Derive the per-slot match-set bitsets from the column cache
+        (presence is exact there — kernels._build_kernel forces lo >= 1).
+        device_errors only, no fault_point: callers inject through
+        _ensure_bits; scrub repairs must not be separately injectable."""
+        with faults.device_errors("bitset_intersect", self.part_id):
+            self.bits = pack_presence_bits(self.cols_hi, self.cols_lo)
+        self._bits_epoch = self.cols_epoch
+        self.stats["bitset_packs"] += 1
+        _node_bitset_add("bitset_packs", 1)
+        _node_bitset_add("bitset_bytes",
+                         self.bits.nbytes - self.stats["bitset_bytes"])
+        self.stats["bitset_bytes"] = self.bits.nbytes
+        self._register_hbm_regions()
+
+    def _reset_bits(self) -> None:
+        """Scrub repair: re-pack from the (separately scrubbed) column
+        cache — host postings remain the source of truth two hops up, so
+        a repaired bitset region serves bit-identical results."""
+        self._repack_bits()
+
+    def _ensure_bits(self) -> None:
+        """Pack (or re-pack after a cols_epoch move) the bitsets before a
+        bitset-engine dispatch; registers the scrub region on first build
+        so the PR-15 integrity plane fingerprints the new columns."""
+        if self.bits is not None and self._bits_epoch == self.cols_epoch:
+            return
+        faults.fault_point("bitset_intersect", self.part_id)
+        first = self.bits is None
+        self._repack_bits()
+        if first:
+            integrity.register_scrub_region(
+                self, "cols_bits", lambda o: o.bits,
+                epoch=lambda o: id(o.bits),
+                repair=lambda o: o._reset_bits())
+
+    def _bitset_slots(self, r: _BoolQuery):
+        """(required slots rarest-df-first, must_not slots largest-first)
+        for the intersect kernel's prefetch rows. Clauses beyond the
+        BITSET_CLAUSES / BITSET_NEGS fan-in are dropped from the MASK
+        only — dropping an AND (or an AND-NOT) term leaves the mask a
+        SUPERSET of the true match set, and the exact host rescore
+        re-tests every clause, so top-k stays bit-identical (the cost is
+        spurious candidates, never missed ones)."""
+        req: Dict[int, int] = {}
+        for t, _, info in r.conj:
+            slot = self._slot_of.get(t)
+            if slot is not None:
+                req[slot] = min(req.get(slot, 1 << 60), info.df)
+        for t, info in r.filters:
+            slot = self._slot_of.get(t)
+            if slot is not None:
+                req[slot] = min(req.get(slot, 1 << 60), info.df)
+        for terms, _, _, pinfo, _ in r.phrases:
+            if pinfo is None:
+                continue
+            slot = self._slot_of.get(pinfo.key)
+            if slot is not None:
+                req[slot] = min(req.get(slot, 1 << 60), len(pinfo.docs))
+        ordered = sorted(req, key=lambda s: (req[s], s))[:BITSET_CLAUSES]
+        mn = []
+        for t, info in r.must_not:
+            slot = self._slot_of.get(t)
+            if slot is not None and slot not in req:
+                mn.append((info.df, slot))
+        mn = [s for _, s in sorted(mn, reverse=True)[:BITSET_NEGS]]
+        return ordered, mn
+
+    def _bitset_prefetch(self, chunk, QC: int):
+        """(q_slots [QC, BITSET_CLAUSES], q_neg [QC, BITSET_NEGS]) i32 —
+        the intersect kernel's scalar-prefetch rows. Sentinels: slot Hp
+        (the build scratch slot, always zero) is the AND-NOT identity
+        and the empty mask; slot Hp + 1 is the packed all-ones row. A
+        None entry (a query a fused peer host-routes) points EVERY
+        clause at the zero sentinel so its mask is empty and its chunks
+        all skip; an active query with no resident required clause pads
+        with the ones sentinel (every live doc passes, as with nreq=0)."""
+        zero_s, ones_s = self.Hp, self.Hp + 1
+        q_slots = np.full((QC, BITSET_CLAUSES), zero_s, np.int32)
+        q_neg = np.full((QC, BITSET_NEGS), zero_s, np.int32)
+        for qi, r in enumerate(chunk):
+            if r is None:
+                continue
+            req, mn = self._bitset_slots(r)
+            if not req:
+                q_slots[qi, :] = ones_s
+            else:
+                for j in range(BITSET_CLAUSES):
+                    q_slots[qi, j] = req[j] if j < len(req) else req[0]
+            q_neg[qi, : len(mn)] = mn
+        return q_slots, q_neg
+
+    def _sweep_bool_bits(self, chunk: Sequence[_BoolQuery], QC: int):
+        """Bitset-engine twin of _sweep_bool: blockwise AND / AND-NOT of
+        the clauses' packed match sets on device, then the mask-gated
+        sweep that skips all-zero chunks. Returns (rm, rr, counts) with
+        counts the per-query nonzero-chunk tally (telemetry)."""
+        wq, _, _, qscale = self._bool_weights(chunk, QC)
+        q_slots, q_neg = self._bitset_prefetch(chunk, QC)
+        with faults.device_dispatch("bitset_intersect", self.part_id):
+            mask = intersect_bitset(
+                jnp.asarray(q_slots), jnp.asarray(q_neg), self.bits,
+                QC=QC, nsw=self.nsw)
+            counts = mask_chunk_counts(mask)
+        with faults.device_dispatch("turbo_sweep", self.part_id):
+            rm, rr = sweep_rowmax_bitset(
+                jnp.asarray(qscale), self.cols_hi, self.cols_lo,
+                jnp.asarray(wq), mask, self.live, QC=QC, nsw=self.nsw)
+        return rm, rr, counts
+
+    def _gallop_routes(self, resolved, device_idx, host_idx):
+        """Ultra-selective leads skip the device sweep entirely: when a
+        query's rarest required clause has df below ES_TPU_BITSET_HOST_DF,
+        the galloping sorted intersection (_intersect_sorted) finishes on
+        host faster than a full-cache sweep can launch."""
+        thr = int(knob("ES_TPU_BITSET_HOST_DF") or 0)
+        if thr <= 0:
+            return device_idx, host_idx
+        keep: List[int] = []
+        moved: List[int] = []
+        for qi in device_idx:
+            r = resolved[qi]
+            dfs = ([i.df for _, _, i in r.conj]
+                   + [i.df for _, i in r.filters]
+                   + [len(p.docs) for _, _, _, p, _ in r.phrases
+                      if p is not None])
+            (moved if dfs and min(dfs) < thr else keep).append(qi)
+        if moved:
+            self.stats["bitset_gallop"] += len(moved)
+            _node_bitset_add("bitset_gallop", len(moved))
+        return keep, sorted(host_idx + moved)
+
+    def _note_bitset_counts(self, cnt, total: Optional[int] = None) -> None:
+        """Fold one dispatch's nonzero-chunk tallies into the skip
+        counters + histograms (`_nodes/stats` tpu_turbo surfaces the
+        stats keys; metrics feed the flight recorder)."""
+        if total is None:
+            total = self.nsw * N_CHUNKS
+        for c in cnt:
+            skipped = max(total - int(c), 0)
+            self.stats["bitset_blocks_skipped"] += skipped
+            _node_bitset_add("bitset_blocks_skipped", skipped)
+            metrics.observe("bitset_blocks_skipped", skipped)
+            metrics.observe("bitset_block_occupancy",
+                            int(c) / max(total, 1))
+
     def _phrase_pf(self, terms, slop, pinfo, docs: np.ndarray):
         """(pf f32[n], present bool[n]) of a phrase at candidate docs."""
         if pinfo is not None:
@@ -1278,7 +1483,7 @@ class TurboBM25:
             req.sort(key=len)
             cand = req[0]
             for s in req[1:]:
-                cand = cand[np.isin(cand, s, assume_unique=True)]
+                cand = _intersect_sorted(cand, s)
                 if not len(cand):
                     return empty
         for terms, slop, _, pinfo, _ in r.phrases:
@@ -1394,6 +1599,12 @@ class TurboBM25:
         resolved = [self._resolve_bool(spec) for spec in queries]
         self._ensure_bool(resolved)
         device_idx, host_idx = self._bool_routes(resolved)
+        use_bits = bool(knob("ES_TPU_BITSET"))
+        if use_bits:
+            device_idx, host_idx = self._gallop_routes(
+                resolved, device_idx, host_idx)
+            if device_idx:
+                self._ensure_bits()
         self.stats["bool_device"] += len(device_idx)
 
         # device pipeline (same two-pass shape as search_many)
@@ -1407,19 +1618,33 @@ class TurboBM25:
             sel = device_idx[off: off + take]
             if check is not None:
                 check()
-            rm, rr = self._sweep_bool([resolved[i] for i in sel],
-                                      take)
+            counts = None
+            if use_bits:
+                first_trace = hbm_ledger.note_dispatch("turbo_bitset", take)
+                tc0 = time.monotonic()
+                rm, rr, counts = self._sweep_bool_bits(
+                    [resolved[i] for i in sel], take)
+            else:
+                rm, rr = self._sweep_bool([resolved[i] for i in sel],
+                                          take)
             with faults.device_errors("turbo_sweep", self.part_id):
                 picked = _pick_rows(rm, rr, n_rows=n_rows)
-            pending.append((sel, picked))
+            if use_bits and first_trace:
+                hbm_ledger.note_compile_done(
+                    "turbo_bitset", take, time.monotonic() - tc0)
+            pending.append((sel, picked, counts))
             off += len(sel)
         self.stats["dispatches"] += len(pending)
 
-        for sel, packed_dev in pending:
+        for sel, packed_dev, counts in pending:
             if check is not None:
                 check()
             with faults.device_errors("turbo_sweep", self.part_id):
                 packed = np.asarray(packed_dev)
+            if counts is not None:
+                with faults.device_errors("bitset_intersect", self.part_id):
+                    self._note_bitset_counts(
+                        np.asarray(counts)[: len(sel)])
             rows_all = packed[:, :n_rows].astype(np.int64)
             bounds = packed[:, n_rows]
             for j, qi in enumerate(sel):
@@ -1549,6 +1774,33 @@ def _fused_sweep_bool(qscale, nreq, cols_hi, cols_lo, wq, wp, live, *,
     return program(qscale, nreq, cols_hi, cols_lo, wq, wp, live)
 
 
+@_partial(jax.jit, static_argnames=("mesh", "QC", "nsw", "n_rows"))
+def _fused_sweep_bitset(qscale, q_slots, q_neg, bits, cols_hi, cols_lo,
+                        wq, live, *, mesh, QC: int, nsw: int, n_rows: int):
+    """Bitset twin of _fused_sweep_bool: per local partition, the packed
+    clause intersection (intersect_bitset) feeds the mask-gated sweep —
+    still ONE launch for every partition. Extra sharded inputs:
+    q_slots [Sp, QC, BITSET_CLAUSES] i32 · q_neg [Sp, QC, BITSET_NEGS]
+    i32 · bits [Sp, Hp+2, nsw * SW_WORD_ROWS, 128] u32. Returns
+    (picked [Sp, QC, n_rows+1] f32, nonzero-chunk counts [Sp, QC] i32)."""
+    spec = _P("shard")
+
+    @_partial(_shard_map, mesh=mesh, in_specs=(spec,) * 8,
+              out_specs=(spec, spec), check_vma=False)
+    def program(qs, sl, ng, bt, ch, cl, w, lv):
+        outs, cnts = [], []
+        for i in range(qs.shape[0]):
+            mask = intersect_bitset(sl[i], ng[i], bt[i], QC=QC, nsw=nsw)
+            rm, rr = sweep_rowmax_bitset(qs[i], ch[i], cl[i], w[i], mask,
+                                         lv[i], QC=QC, nsw=nsw)
+            outs.append(_pick_rows(rm, rr, n_rows=n_rows))
+            cnts.append(mask_chunk_counts(mask))
+        return jnp.stack(outs), jnp.stack(cnts)
+
+    return program(qscale, q_slots, q_neg, bits, cols_hi, cols_lo, wq,
+                   live)
+
+
 class ShardedTurbo:
     """S > 1 TurboBM25 partitions fused into ONE device dispatch per
     query chunk (the paper's ICI-sharded serving design): each
@@ -1596,6 +1848,10 @@ class ShardedTurbo:
         self._sharding = sh
         self._live_host = lv     # retained: scrub fingerprint + repair src
         self._epochs = [-1] * S
+        # stacked per-partition bitsets (allocated lazily on the first
+        # bitset-engine refresh; padded partitions stay all-zero = empty)
+        self.bits = None
+        self._bits_epochs = [-1] * S
         self.fused_dispatches = 0
         # fused cache is a separate device allocation on top of the
         # per-partition engines' own regions
@@ -1607,6 +1863,8 @@ class ShardedTurbo:
     def _register_hbm_regions(self) -> None:
         self._hbm.set_region("cols_hi", self.cols_hi.nbytes)
         self._hbm.set_region("cols_lo", self.cols_lo.nbytes)
+        self._hbm.set_region("cols_bits",
+                             0 if self.bits is None else self.bits.nbytes)
         self._hbm.set_region("live", self.live.nbytes)
 
     def _register_scrub_regions(self) -> None:
@@ -1638,6 +1896,19 @@ class ShardedTurbo:
         self._epochs = [-1] * len(self.turbos)
         self._refresh()
 
+    def _reset_fused_bits(self) -> None:
+        """Scrub repair for the stacked bitsets: zero, then re-sync every
+        partition slice from the engines' own (separately scrubbed)
+        bits."""
+        if self.bits is None:
+            return
+        zeros = np.zeros(self.bits.shape, np.uint32)
+        with faults.device_errors("column_upload"):
+            self.bits = jax.device_put(zeros, self._sharding)
+        self._bits_epochs = [-1] * len(self.turbos)
+        for i in range(len(self.turbos)):
+            self._refresh_bits_part(i)
+
     def extend_qc_sizes(self, sizes) -> None:
         """Bucket-ladder hook, fused flavor: keeps the fused chunker and
         the per-partition engines (host rescore / fallback paths) on the
@@ -1646,6 +1917,8 @@ class ShardedTurbo:
             t.extend_qc_sizes(sizes)
         self.qc_sizes = self.turbos[0].qc_sizes
         hbm_ledger.note_primed("fused_turbo", self.qc_sizes)
+        hbm_ledger.note_primed("fused_turbo_bool", self.qc_sizes)
+        hbm_ledger.note_primed("fused_turbo_bitset", self.qc_sizes)
 
     def _refresh_part(self, i: int) -> None:
         """Re-sync one partition's fused column slice if its cache was
@@ -1661,6 +1934,36 @@ class ShardedTurbo:
                 self.cols_lo.at[i, :a, :b].set(t.cols_lo), self._sharding)
         self._epochs[i] = t.cols_epoch
         self._register_hbm_regions()
+        self._refresh_bits_part(i)
+
+    def _refresh_bits_part(self, i: int) -> None:
+        """Re-sync one partition's stacked bitset slice. The stacked
+        array is allocated lazily on the first sync (disjunction-only
+        serving never pays the HBM) — partition-local slot numbering is
+        preserved, so each engine's own sentinels (t.Hp zeros, t.Hp + 1
+        ones) land inside its slice and padding slots stay all-zero."""
+        t = self.turbos[i]
+        if t.bits is None or self._bits_epochs[i] == t._bits_epoch:
+            return
+        first = self.bits is None
+        if first:
+            zeros = np.zeros(
+                (self.Sp, self.Hp + 2, self.nsw * SW_WORD_ROWS, 128),
+                np.uint32)
+            with faults.device_errors("column_upload"):
+                self.bits = jax.device_put(zeros, self._sharding)
+        with faults.device_dispatch("column_upload", part=i):
+            hb, wb = t.bits.shape[0], t.bits.shape[1]
+            self.bits = jax.device_put(
+                self.bits.at[i, :hb, :wb].set(t.bits), self._sharding)
+        self._bits_epochs[i] = t._bits_epoch
+        if first:
+            _node_bitset_add("bitset_bytes", self.bits.nbytes)
+            integrity.register_scrub_region(
+                self, "cols_bits", lambda o: o.bits,
+                epoch=lambda o: id(o.bits),
+                repair=lambda o: o._reset_fused_bits())
+        self._register_hbm_regions()
 
     def _refresh(self) -> None:
         for i in range(len(self.turbos)):
@@ -1668,6 +1971,7 @@ class ShardedTurbo:
 
     def hbm_bytes(self) -> int:
         return (self.cols_hi.nbytes + self.cols_lo.nbytes
+                + (0 if self.bits is None else self.bits.nbytes)
                 + self.live.nbytes)
 
     # ---------------- fused dispatches ----------------
@@ -1709,11 +2013,21 @@ class ShardedTurbo:
         return out
 
     def _dispatch_bool(self, resolved, dev_sets, sel, QC: int,
-                       n_rows: int):
+                       n_rows: int, use_bits: bool = False):
+        """Returns (packed rows, nonzero-chunk counts) — counts is None
+        on the dense (coverage-matmul) engine. A query a partition
+        host-routes rides the fused launch with inert inputs: all-zero
+        weights on both engines, and on the bitset engine every clause
+        slot pointed at that partition's zero sentinel (empty mask)."""
         wq = np.zeros((self.Sp, 2, QC, self.Hp + 1), np.int8)
         wp = np.zeros((self.Sp, QC, self.Hp + 1), np.int8)
         nreq = np.zeros((self.Sp, QC, 1), np.int32)
         qs = np.ones((self.Sp, QC, 1), np.float32)
+        if use_bits:
+            # padded partitions keep slot 0: their bits slice is all-zero,
+            # so every mask word is 0 and every chunk skips
+            q_slots = np.zeros((self.Sp, QC, BITSET_CLAUSES), np.int32)
+            q_neg = np.zeros((self.Sp, QC, BITSET_NEGS), np.int32)
         for i, t in enumerate(self.turbos):
             chunk = [resolved[i][qi] if qi in dev_sets[i] else None
                      for qi in sel]
@@ -1723,19 +2037,31 @@ class ShardedTurbo:
             wp[i, :, :hp] = p
             nreq[i] = nr
             qs[i] = q
+            if use_bits:
+                q_slots[i], q_neg[i] = t._bitset_prefetch(chunk, QC)
         t0 = time.monotonic()
-        first_trace = hbm_ledger.note_dispatch("fused_turbo_bool", QC)
+        kind = "fused_turbo_bitset" if use_bits else "fused_turbo_bool"
+        first_trace = hbm_ledger.note_dispatch(kind, QC)
+        cnts = None
         with faults.device_dispatch("fused_dispatch"):
-            out = _fused_sweep_bool(
-                jnp.asarray(qs), jnp.asarray(nreq), self.cols_hi,
-                self.cols_lo, jnp.asarray(wq), jnp.asarray(wp), self.live,
-                mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
+            if use_bits:
+                out, cnts = _fused_sweep_bitset(
+                    jnp.asarray(qs), jnp.asarray(q_slots),
+                    jnp.asarray(q_neg), self.bits, self.cols_hi,
+                    self.cols_lo, jnp.asarray(wq), self.live,
+                    mesh=self.mesh, QC=QC, nsw=self.nsw, n_rows=n_rows)
+            else:
+                out = _fused_sweep_bool(
+                    jnp.asarray(qs), jnp.asarray(nreq), self.cols_hi,
+                    self.cols_lo, jnp.asarray(wq), jnp.asarray(wp),
+                    self.live, mesh=self.mesh, QC=QC, nsw=self.nsw,
+                    n_rows=n_rows)
         self.fused_dispatches += 1
         if first_trace:
             hbm_ledger.note_compile_done(
-                "fused_turbo_bool", QC, time.monotonic() - t0)
+                kind, QC, time.monotonic() - t0)
         self._trace_chunk(QC, t0)
-        return out
+        return out, cnts
 
     # ---------------- search ----------------
 
@@ -1836,13 +2162,19 @@ class ShardedTurbo:
         out_d = np.zeros((S, Q, k), np.int32)
         resolved = [[t._resolve_bool(spec) for spec in queries]
                     for t in self.turbos]
+        use_bits = bool(knob("ES_TPU_BITSET"))
         failed: Dict[int, DeviceFaultError] = {}
         routes = []
         for si, t in enumerate(self.turbos):
             try:
                 t._ensure_bool(resolved[si])
+                if use_bits:
+                    t._ensure_bits()
                 self._refresh_part(si)
-                routes.append(t._bool_routes(resolved[si]))
+                rt = t._bool_routes(resolved[si])
+                if use_bits:
+                    rt = t._gallop_routes(resolved[si], *rt)
+                routes.append(rt)
             except DeviceFaultError as e:
                 failed[si] = e
                 # every resolvable query host-routes for this partition
@@ -1863,26 +2195,35 @@ class ShardedTurbo:
             if check is not None:
                 check()
             try:
-                packed_dev = self._dispatch_bool(
-                    resolved, dev_sets, sel, take, n_rows)
+                packed_dev, cnts_dev = self._dispatch_bool(
+                    resolved, dev_sets, sel, take, n_rows,
+                    use_bits=use_bits)
             except DeviceFaultError as e:
-                packed_dev, fused_err = None, e
-            pending.append((sel, packed_dev))
+                packed_dev, cnts_dev, fused_err = None, None, e
+            pending.append((sel, packed_dev, cnts_dev))
             off += len(sel)
-        for sel, packed_dev in pending:
+        for sel, packed_dev, cnts_dev in pending:
             if check is not None:
                 check()
-            packed = None
+            packed = cc = None
             if packed_dev is not None:
                 try:
                     with faults.device_errors("fused_dispatch"):
                         packed = np.asarray(packed_dev)
+                        if cnts_dev is not None:
+                            cc = np.asarray(cnts_dev)
                 except DeviceFaultError as e:
-                    packed, fused_err = None, e
+                    packed, cc, fused_err = None, None, e
             for si, t in enumerate(self.turbos):
                 if packed is not None:
                     rows_all = packed[si, :, :n_rows].astype(np.int64)
                     bounds = packed[si, :, n_rows]
+                if cc is not None:
+                    act = [j for j, qi in enumerate(sel)
+                           if qi in dev_sets[si]]
+                    if act:
+                        t._note_bitset_counts(
+                            cc[si, act], total=self.nsw * N_CHUNKS)
                 for j, qi in enumerate(sel):
                     if qi not in dev_sets[si]:
                         continue

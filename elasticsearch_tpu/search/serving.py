@@ -351,8 +351,12 @@ _TURBO_NODE_LOCK = threading.Lock()
 
 
 def turbo_node_stats() -> dict:
+    from elasticsearch_tpu.parallel.turbo import node_bitset_stats
+
     with _TURBO_NODE_LOCK:
-        return dict(_TURBO_NODE_STATS)
+        out = dict(_TURBO_NODE_STATS)
+    out.update(node_bitset_stats())
+    return out
 
 
 def engine_desc(eng) -> Tuple[str, int]:
@@ -677,11 +681,9 @@ class TurboEngine:
                                 fault_log=fault_log)
 
     def hbm_bytes(self) -> int:
-        total = 0
-        for t in self.turbos:
-            total += (t.cols_hi.nbytes + t.cols_lo.nbytes
-                      + t.lane_docs.nbytes + t.lane_scores.nbytes
-                      + t.live.nbytes)
+        # per-engine hbm_bytes so every ledgered region (including the
+        # lazily packed bool bitsets) is counted exactly once
+        total = sum(t.hbm_bytes() for t in self.turbos)
         if self._sharded is not None:
             total += self._sharded.hbm_bytes()
         return total

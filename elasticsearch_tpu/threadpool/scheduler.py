@@ -67,6 +67,49 @@ _TIERS = (TIER_INTERACTIVE, TIER_BULK)
 
 DEFAULT_BUCKETS = (1, 4, 16, 64, 256)
 
+# ladder autotune (knob unset): derive rungs from the observed flush-time
+# demand. The queue-depth histogram (count kind, power-of-2 bucket upper
+# bounds) gives the rung positions; the pad-ratio histogram decides
+# whether to densify them. Each rung is one compiled kernel shape, so the
+# ladder is cached and only re-derived after AUTOTUNE_REOBS more flushes.
+AUTOTUNE_MIN_OBS = 64     # flushes before trusting the histograms at all
+AUTOTUNE_REOBS = 256      # new flushes between ladder re-derivations
+AUTOTUNE_CAP = 512        # largest rung autotune will compile
+AUTOTUNE_PAD_P90 = 0.25   # p90 pad waste that triggers densification
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def _derive_ladder(depth: dict, pad: Optional[dict]) -> Tuple[int, ...]:
+    """Ladder from flush-time demand: rungs at the queue-depth p50/p90/p99
+    (already power-of-2 bucket bounds) plus the rounded-up max, always
+    anchored at 1 (a lone interactive query must never pad). When the
+    observed pad waste stays high anyway, add geometric midpoints between
+    adjacent rungs — halving the worst-case pad at the cost of more
+    compiled shapes."""
+    pts = {1}
+    for key in ("p50", "p90", "p99"):
+        v = int(depth.get(key, 0))
+        if v > 0:
+            pts.add(min(_next_pow2(v), AUTOTUNE_CAP))
+    mx = int(depth.get("max", 0))
+    if mx > 0:
+        pts.add(min(_next_pow2(mx), AUTOTUNE_CAP))
+    rungs = sorted(pts)
+    if (pad and pad.get("count", 0) >= AUTOTUNE_MIN_OBS
+            and pad.get("p90", 0.0) > AUTOTUNE_PAD_P90):
+        dense = set(rungs)
+        for lo, hi in zip(rungs, rungs[1:]):
+            if hi >= 4 * lo:
+                dense.add(_next_pow2(int((lo * hi) ** 0.5)))
+        rungs = sorted(dense)
+    return tuple(rungs)
+
 # how long a lane's dispatch thread idles on an empty queue before
 # retiring itself (and unregistering the lane, so a snapshot refresh's
 # swapped-out engine can be garbage collected)
@@ -235,13 +278,41 @@ class AdaptiveDispatchScheduler:
         # per-lane in-flight batches, the raw series behind the sampler's
         # per-lane device busy fraction (PR 12)
         self._lane_inflight: Dict[Tuple[int, int], int] = {}  # guarded by: _lock
+        # autotuned ladder cache (knob unset); own lock: ladder() is read
+        # under _lock by stats(), so the cache must not share it
+        self._auto_lock = threading.Lock()
+        self._auto_ladder: Optional[Tuple[int, ...]] = None  # guarded by: _auto_lock
+        self._auto_obs = 0            # guarded by: _auto_lock
 
     # ---- knob-or-constructor configuration ----
 
     def ladder(self) -> Tuple[int, ...]:
         if self._buckets is not None:
             return self._buckets
-        return _parse_buckets(knob("ES_TPU_SCHED_BUCKETS"))
+        raw = knob("ES_TPU_SCHED_BUCKETS", default=None)
+        if raw is not None:
+            return _parse_buckets(raw)
+        return self._autotune_ladder()
+
+    def _autotune_ladder(self) -> Tuple[int, ...]:
+        """Knob-unset ladder: DEFAULT_BUCKETS until enough flushes have
+        been observed, then the demand-derived ladder, re-derived only
+        every AUTOTUNE_REOBS flushes (each rung is a compiled shape — a
+        jittery ladder would churn the kernel cache)."""
+        depth = metrics.summary("sched_queue_depth") or {}
+        n = int(depth.get("count", 0))
+        with self._auto_lock:
+            if (self._auto_ladder is not None
+                    and n - self._auto_obs < AUTOTUNE_REOBS):
+                return self._auto_ladder
+        if n < AUTOTUNE_MIN_OBS:
+            return DEFAULT_BUCKETS
+        derived = _derive_ladder(depth,
+                                 metrics.summary("coalesce_pad_ratio"))
+        with self._auto_lock:
+            self._auto_ladder = derived
+            self._auto_obs = n
+            return self._auto_ladder
 
     def budget_s(self, tier: str) -> float:
         if tier == TIER_BULK:
@@ -372,14 +443,21 @@ class AdaptiveDispatchScheduler:
         """Push the bucket ladder into the engine's compiled-width cache
         (TurboBM25 / ShardedTurbo qc_sizes): each bucket becomes one
         cached kernel shape so a flush to bucket B pads to B, not to the
-        engine's default widths. Engines without the hook (BlockMax,
-        stubs) keep their own internal chunking."""
+        engine's default widths. The primed ladder itself is the guard —
+        an autotune re-derivation (or a live knob change) re-primes the
+        engine before the new rungs ever reach a flush, so the widened
+        shapes are traced once up front instead of retracing mid-dispatch.
+        Engines without the hook (BlockMax, stubs) keep their own internal
+        chunking."""
         ext = getattr(engine, "extend_qc_sizes", None)
-        if ext is None or getattr(engine, "_sched_primed_", False):
+        if ext is None:
+            return
+        ladder = self.ladder()
+        if getattr(engine, "_sched_primed_", None) == ladder:
             return
         try:
-            ext(self.ladder())
-            engine._sched_primed_ = True
+            ext(ladder)
+            engine._sched_primed_ = ladder
         except AttributeError:     # __slots__ engines: re-prime per lane
             pass
 
@@ -467,6 +545,10 @@ class AdaptiveDispatchScheduler:
         return _SchedBatch(lane.engine, lane.k, chosen, bucket), depth
 
     def _execute(self, lane: _Lane, batch: _SchedBatch, depth: int) -> None:
+        # ladder-change re-prime (near-free tuple compare when unchanged):
+        # the batch's bucket may be a rung the lane-creation prime never
+        # saw if the autotuner re-derived while the lane was alive
+        self._prime_engine(lane.engine)
         # take an in-flight slot BEFORE the device call; the last waiter
         # to consume the batch gives it back (double buffering: demux of
         # this batch overlaps the device sweep of the next one)
@@ -534,8 +616,15 @@ class AdaptiveDispatchScheduler:
                         self._tier_wait_ms.get(t, 0.0)
                         / max(1, self._tier_counts.get(t, 0)), 3)}
                 for t in _TIERS}
+            source = ("constructor" if self._buckets is not None
+                      else "knob"
+                      if knob("ES_TPU_SCHED_BUCKETS", default=None)
+                      is not None
+                      else "auto" if self._auto_ladder is not None
+                      else "default")
             return {
                 "buckets": list(self.ladder()),
+                "bucket_source": source,
                 "interactive_budget_us":
                     self.budget_s(TIER_INTERACTIVE) * 1e6,
                 "bulk_budget_us": self.budget_s(TIER_BULK) * 1e6,

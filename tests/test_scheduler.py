@@ -613,3 +613,72 @@ def test_serving_path_batches_through_scheduler(monkeypatch):
             assert g["hits"]["total"] == w["hits"]["total"], b
     finally:
         svc.close()
+
+
+# ---------------------------------------------------------------------------
+# bucket-ladder autotune (PR 16): knob unset -> ladder derived from the
+# observed flush-time demand histograms
+# ---------------------------------------------------------------------------
+
+
+def test_derive_ladder_from_synthetic_histograms():
+    from elasticsearch_tpu.threadpool.scheduler import _derive_ladder
+
+    depth = {"count": 500, "p50": 4, "p90": 32, "p99": 64, "max": 200}
+    # rungs at the depth percentiles + rounded-up max, anchored at 1
+    assert _derive_ladder(depth, None) == (1, 4, 32, 64, 256)
+    # low pad waste: no densification
+    assert _derive_ladder(depth, {"count": 500, "p90": 0.1}) == \
+        (1, 4, 32, 64, 256)
+    # persistent pad waste adds geometric midpoints into the wide gaps
+    assert _derive_ladder(depth, {"count": 500, "p90": 0.6}) == \
+        (1, 2, 4, 16, 32, 64, 128, 256)
+    # the cap bounds the largest compiled shape
+    assert _derive_ladder({"count": 100, "p50": 1024, "p90": 2048,
+                           "p99": 4096, "max": 4000}, None)[-1] == 512
+
+
+def test_autotune_ladder_pins_synthetic_trace(monkeypatch):
+    """Knob unset: the ladder stays at DEFAULT_BUCKETS until enough
+    flushes are observed, then pins to the demand-derived rungs for a
+    bimodal synthetic trace (singles + ~48-deep bursts) and caches."""
+    monkeypatch.delenv("ES_TPU_SCHED_BUCKETS", raising=False)
+    metrics.reset_for_tests()
+    sched = AdaptiveDispatchScheduler()
+    assert sched.ladder() == DEFAULT_BUCKETS      # under-observed
+    for _ in range(100):
+        metrics.observe("sched_queue_depth", 1)
+    for _ in range(40):
+        metrics.observe("sched_queue_depth", 48)
+    lad = sched.ladder()
+    assert lad == (1, 64)        # p50 bucket bound 1, burst bound 64
+    assert sched.ladder() is lad or sched.ladder() == lad   # cached
+    st = sched.stats()
+    assert st["bucket_source"] == "auto"
+    assert st["buckets"] == [1, 64]
+    # an explicit knob immediately overrides the autotuner
+    monkeypatch.setenv("ES_TPU_SCHED_BUCKETS", "2,8")
+    assert sched.ladder() == (2, 8)
+    assert sched.stats()["bucket_source"] == "knob"
+
+
+def test_prime_reprimes_on_ladder_change():
+    """The primed-ladder guard: an unchanged ladder never re-primes, a
+    changed one pushes the new rungs into the engine's compiled widths
+    before any flush can use them."""
+
+    class _Eng:
+        def __init__(self):
+            self.calls = []
+
+        def extend_qc_sizes(self, sizes):
+            self.calls.append(tuple(sizes))
+
+    sched = AdaptiveDispatchScheduler(buckets=(1, 4))
+    e = _Eng()
+    sched._prime_engine(e)
+    sched._prime_engine(e)                        # no ladder change
+    assert e.calls == [(1, 4)]
+    sched._buckets = (1, 4, 32)                   # ladder re-derived
+    sched._prime_engine(e)
+    assert e.calls == [(1, 4), (1, 4, 32)]
