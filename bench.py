@@ -533,6 +533,12 @@ def main():
     es_c1 = stats_delta(st_c1, engine_stats(eng))
     if es_c1 is not None:
         c1["engine_stats"] = es_c1
+        # the cold-tier handoff this leg is meant to pin: with eager
+        # sparse slices on, the Zipf tail serves on device
+        # (sparse_queries moves, cold_queries stays 0) and
+        # config1_warmup_s stops paying the host cold-path priming
+        c1["cold_queries"] = int(es_c1.get("cold_queries", 0))
+        c1["sparse_queries"] = int(es_c1.get("sparse_queries", 0))
     RESULT["value"] = round(match_qps, 1)
     RESULT["vs_baseline"] = round(match_qps / cpu_match_qps, 2)
     log(f"config1 ({eng.kind}): {match_qps:.1f} qps, "
@@ -1055,6 +1061,119 @@ def dryrun_bitset() -> int:
     }), flush=True)
     log(f"dryrun_bitset: identical={identical} skipped={skipped} "
         f"retraces={retraces} ledger_ok={ledger_ok}")
+    return 0 if ok else 1
+
+
+def dryrun_sparse() -> int:
+    """Eager-sparse-tier dry-run (PR 17): 2-partition fused engine on the
+    virtual CPU mesh, a config1-shaped Zipf disjunctive mix whose tail
+    terms sit below COLD_DF, asserting (a) top-10 bit-identity with
+    search_many_host, (b) cold_queries == 0 on the device path (the host
+    cold fork is retired; sparse_queries moves instead), (c) zero
+    retraces once shapes are primed via extend_qc_sizes, (d) ledger ==
+    engine HBM bytes with the slice pools resident, and (e) the
+    ES_TPU_SPARSE=0 A/B reproducing today's host-fork counters with the
+    same bits. One JSON line on stdout; exit 0/1."""
+    os.environ.setdefault("ES_TPU_FORCE_TURBO", "1")
+    os.environ["ES_TPU_SPARSE"] = "1"
+    if os.environ.get("TEST_ON_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.common import hbm_ledger
+    from elasticsearch_tpu.index.segment import build_field_postings
+    from elasticsearch_tpu.parallel.spmd import build_stacked_bm25
+    from elasticsearch_tpu.parallel.turbo import TurboBM25
+    from elasticsearch_tpu.search.serving import TurboEngine, _turbo_mesh
+
+    def part(n_docs, vocab, seed):
+        rng = np.random.default_rng(seed)
+        probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+        probs /= probs.sum()
+        lens = rng.integers(4, 24, size=n_docs).astype(np.int64)
+        tokens = rng.choice(vocab, size=int(lens.sum()),
+                            p=probs).astype(np.int64)
+        tok_docs = np.repeat(np.arange(n_docs, dtype=np.int64), lens)
+        fp = build_field_postings(
+            "body", lens, tok_docs, tokens,
+            [f"t{i}" for i in range(vocab)])
+        stacked = build_stacked_bm25([_Seg(n_docs, fp)], "body",
+                                     serve_only=True)
+        # cold_df mid-spectrum: head terms colize, the Zipf tail is cold
+        return TurboBM25(stacked, hbm_budget_bytes=64 << 20, cold_df=400)
+
+    def build():
+        return TurboEngine([part(2600, 40, 1), part(1800, 32, 2)],
+                           mesh=_turbo_mesh(2))
+
+    log("dryrun_sparse: building 2-partition fused engine...")
+    eng = build()
+    # config1-shaped mix: Zipf-drawn term pairs, so most queries carry at
+    # least one sub-COLD_DF tail term — the 116s-warmup population
+    rng = np.random.default_rng(7)
+    probs = 1.0 / np.arange(1, 33) ** 1.07
+    probs /= probs.sum()
+    t = rng.choice(32, size=(24, 2), p=probs)
+    t[:, 1] = np.where(t[:, 1] == t[:, 0], (t[:, 1] + 1) % 32, t[:, 1])
+    queries = [[(f"t{a}", 1.0), (f"t{b}", 1.0)] for a, b in t]
+    k = 10
+    eng.extend_qc_sizes([len(queries)])
+    eng._fused()
+    eng.extend_qc_sizes([len(queries)])   # fused dispatcher too (lazy init)
+    eng.search_many([queries], k=k)       # warm pass builds the slices
+    r0 = hbm_ledger.compile_stats()["retraces"]
+    got = eng.search_many([queries], k=k)[0]
+    retraces = hbm_ledger.compile_stats()["retraces"] - r0
+    want = eng._merge3([tb.search_many_host([queries], k=k)[0]
+                        for tb in eng.turbos], len(queries), k)
+    identical = all(np.array_equal(np.asarray(g), np.asarray(w))
+                    for g, w in zip(got, want))
+    st = eng.stats
+    cold_q = int(st.get("cold_queries", 0))
+    sparse_q = int(st.get("sparse_queries", 0))
+    slices = int(st.get("sparse_slices", 0))
+    fallbacks = int(st.get("sparse_fallbacks", 0))
+    ledger_ok = all(tb._hbm.total_bytes() == tb.hbm_bytes()
+                    for tb in eng.turbos)
+    # A/B: the knob restores today's host cold fork with the same bits
+    os.environ["ES_TPU_SPARSE"] = "0"
+    try:
+        ab = build()
+        ab.extend_qc_sizes([len(queries)])
+        ab._fused()
+        ab.extend_qc_sizes([len(queries)])
+        got_ab = ab.search_many([queries], k=k)[0]
+    finally:
+        os.environ["ES_TPU_SPARSE"] = "1"
+    ab_identical = all(np.array_equal(np.asarray(g), np.asarray(w))
+                       for g, w in zip(got_ab, want))
+    ab_st = ab.stats
+    ab_ok = (ab_identical and int(ab_st.get("cold_queries", 0)) > 0
+             and int(ab_st.get("sparse_queries", 0)) == 0
+             and int(ab_st.get("sparse_slices", 0)) == 0)
+    ok = (identical and cold_q == 0 and sparse_q > 0 and slices > 0
+          and fallbacks == 0 and retraces == 0 and ledger_ok and ab_ok)
+    print(json.dumps({
+        "metric": "dryrun_sparse",
+        "ok": bool(ok),
+        "top10_agreement": 1.0 if identical else 0.0,
+        "cold_queries": cold_q,
+        "sparse_queries": sparse_q,
+        "sparse_slices": slices,
+        "sparse_bytes": int(st.get("sparse_bytes", 0)),
+        "sparse_fallbacks": fallbacks,
+        "retraces": int(retraces),
+        "ledger_matches_engine": bool(ledger_ok),
+        "ab_host_fork_ok": bool(ab_ok),
+        "ab_cold_queries": int(ab_st.get("cold_queries", 0)),
+    }), flush=True)
+    log(f"dryrun_sparse: identical={identical} cold_q={cold_q} "
+        f"sparse_q={sparse_q} retraces={retraces} ab_ok={ab_ok}")
     return 0 if ok else 1
 
 
@@ -1939,6 +2058,9 @@ if __name__ == "__main__":
     if "dryrun_bitset" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_bitset":
         sys.exit(dryrun_bitset())
+    if "dryrun_sparse" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_sparse":
+        sys.exit(dryrun_sparse())
     if "dryrun_disruption" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_disruption":
         sys.exit(dryrun_disruption())

@@ -91,6 +91,7 @@ KNOWN_SITES = frozenset({
     "merge_kernel",      # device-side partition top-k merge
     "column_upload",     # int8 column build/refresh onto the device
     "bitset_intersect",  # packed-uint32 bool match-set pack/intersect
+    "sparse_gather",     # eager sparse slice build/upload + gather dispatch
     "blockmax_pass",     # BlockMax engine device pass
 }) | TRANSPORT_SITES | DURABILITY_SITES | OVERLOAD_SITES | CORRUPTION_SITES
 

@@ -433,6 +433,8 @@ declare_histogram("sched_queue_depth", "count", "lane queue depth at each adapti
 # device bitset intersection for bool queries (PR 16)
 declare_histogram("bitset_blocks_skipped", "count", "2048-doc chunks skipped (all-zero intersected match set) per bool query dispatch")
 declare_histogram("bitset_block_occupancy", "ratio", "fraction of 2048-doc chunks with surviving docs after clause intersection, per bool query")
+# eager sparse impact slices for cold terms (PR 17)
+declare_histogram("sparse_slice_width", "count", "padded width (postings) of the ladder rung chosen per eager sparse cold-term slice build")
 declare_histogram("sched_tier_wait.interactive", "ms", "scheduler wait, interactive tier (enqueue -> batch results ready)")
 declare_histogram("sched_tier_wait.bulk", "ms", "scheduler wait, bulk tier (enqueue -> batch results ready)")
 # cluster task plane (PR 11); task_duration.* names are composed

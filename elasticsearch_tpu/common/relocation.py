@@ -26,6 +26,8 @@ _RELOC_COUNTERS: Dict[str, int] = {  # guarded by: _RELOC_LOCK
     "fields_warmed": 0,    # per-field engines built+uploaded ahead of serving
     "warm_failures": 0,    # warm handoffs that errored (relocation proceeds
                            # cold — warming is best-effort)
+    "sparse_prewarms": 0,  # cold-term sparse slices rebuilt on the target
+                           # from the source's hot term list
 }
 
 

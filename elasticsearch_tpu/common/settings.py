@@ -326,6 +326,14 @@ declare_knob("ES_TPU_BITSET_HOST_DF", "int", 512,
              "Bool queries whose rarest required clause has df below this "
              "route to the galloping host intersection instead of the "
              "device bitset sweep (0 disables the fallback)")
+declare_knob("ES_TPU_SPARSE", "flag", True,
+             "Eager sparse impact slices: cold (df < COLD_DF) terms score "
+             "on device via the sparse_gather kernel instead of the host "
+             "cold path (0 restores the host fork for A/B)")
+declare_knob("ES_TPU_SPARSE_WIDTHS", "str", "1024,4096,16384",
+             "Comma-separated slice-width ladder for eager sparse cold-"
+             "term slices (each rung rounds up to a 1024-posting granule; "
+             "a term uses the smallest rung >= its df)")
 declare_knob("ES_TPU_DISABLE_SHARD_SERVING", "flag", False,
              "'1' disables the shard-level serving fast path on data nodes")
 declare_knob("ES_TPU_SEARCH_SHARD_RETRIES", "int", 3,

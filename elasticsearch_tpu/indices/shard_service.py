@@ -692,11 +692,22 @@ class DistributedShardService:
         p = req.payload
         inst = self.get_shard(p["index"], p["shard_id"])
         fields: List[str] = []
+        sparse_terms: Dict[str, List[str]] = {}
         ctx = getattr(inst, "_serving_ctx", None)
         snap = getattr(ctx, "_snapshot", None) if ctx is not None else None
         if snap is not None:
             fields = sorted(getattr(snap, "_bm", {}))
-        return {"fields": fields, "shapes": hbm_ledger.hot_shapes()}
+            # the hot cold-tier: terms with resident eager-sparse slices,
+            # so the target can pre-slice them instead of rebuilding under
+            # first-query latency
+            for field in fields:
+                eng = snap.engine(field)
+                if eng is not None and hasattr(eng, "sparse_hot_terms"):
+                    terms = eng.sparse_hot_terms()
+                    if terms:
+                        sparse_terms[field] = terms
+        return {"fields": fields, "shapes": hbm_ledger.hot_shapes(),
+                "sparse_terms": sparse_terms}
 
     def warm_relocation_handoff(self, inst: ShardInstance,
                                 source_node: str) -> None:
@@ -735,6 +746,9 @@ class DistributedShardService:
                 if sizes and hasattr(eng, "extend_qc_sizes"):
                     eng.extend_qc_sizes(sizes)
                     primed += len(sizes)
+                terms = info.get("sparse_terms", {}).get(field)
+                if terms and hasattr(eng, "prewarm_sparse"):
+                    _rcount("sparse_prewarms", eng.prewarm_sparse(terms))
             _rcount("warm_handoffs")
             _rcount("fields_warmed", warmed)
             _rcount("shapes_primed", primed)

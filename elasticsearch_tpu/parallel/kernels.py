@@ -25,8 +25,15 @@ entirely as matmuls and tiled vector ops:
   row-max cascade (one full pass, then NCAND cheap [512]-wide passes) —
   nothing O(n_docs) ever leaves the chip.
 
-Terms too sparse to justify a column (df below the cold threshold) are
-scored exactly on the host — their lane counts are tiny (turbo.py).
+* **Eager sparse impact slices for the cold tier.** Terms too sparse to
+  justify a dense column (df below the cold threshold) keep their postings
+  as packed ``doc << 8 | impact`` int32 lanes in a granule pool
+  (pre-multiplied BM25 impacts, uint8-quantized — the BM25S eager-scoring
+  representation). `sparse_gather` scatters every queried slice into a
+  dense per-tile accumulator with the SAME outer-product trick as the
+  column builder, then gathers the accumulated per-doc totals back at the
+  slice's own lanes — so cold terms are scored on device too, and the
+  host only bound-prunes + exact-rescores (turbo.py `_sparse_contrib`).
 """
 
 from __future__ import annotations
@@ -52,6 +59,8 @@ COLSCALE = (K1 + 1.0) / 127.0       # hi-layer int8 step
 COLSCALE2 = COLSCALE / 128.0        # lo-layer step (~14-bit combined)
 MAX_GROUP_ROWS = 144  # posting rows DMA'd per build group (tile spans
 #                       <= 130 rows; padded to a sublane multiple)
+SPARSE_GRAN = 1024    # packed (doc, impact) lanes per slice-pool granule
+SPARSE_IMP_MAX = 255  # uint8 impact quantization ceiling (doc << 8 | imp)
 
 
 def _interpret() -> bool:
@@ -768,3 +777,164 @@ def build_columns(g_rows, g_nrows, g_base, g_slot,
     )
     return fn(g_rows, g_nrows, g_base, g_slot, lane_docs, lane_scores,
               cols_hi, cols_lo)
+
+
+# --------------------------------------------------------------------------
+# eager sparse impact gather kernel (cold tier on device)
+# --------------------------------------------------------------------------
+
+
+def _sparse_scatter_kernel():
+    def kernel(coff, cw, ct0, ct1, pool_blk, acc_ref):
+        t = pl.program_id(0)
+        rc = pl.program_id(1)
+
+        @pl.when(rc == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros((1, 128, 128), jnp.float32)
+
+        # a chunk's docs are sorted, so the host-prefetched inclusive tile
+        # range [ct0, ct1] skips every tile the chunk cannot touch (padding
+        # chunks carry the empty range (1, 0) and never scatter)
+        @pl.when((t >= ct0[rc]) & (t <= ct1[rc]))
+        def _scatter():
+            base = t * TILE
+            col = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+            w = cw[rc]
+            tacc = jnp.zeros((128, 128), jnp.float32)
+            for r in range(SPARSE_GRAN // 128):
+                v = pool_blk[0, r, :]                     # [128] i32 packed
+                doc = jax.lax.shift_right_logical(v, 8)
+                imp = jnp.bitwise_and(v, SPARSE_IMP_MAX)
+                rel = doc - base
+                ok = (imp > 0) & (rel >= 0) & (rel < TILE)
+                rel = jnp.where(ok, rel, 0)
+                val = jnp.where(ok, imp.astype(jnp.float32) * w, 0.0)
+                hi = jax.lax.shift_right_logical(rel, 7)[:, None]
+                lo = jnp.bitwise_and(rel, 127)[:, None]
+                A = jnp.where(col == hi, 1.0, 0.0)
+                Bm = jnp.where(col == lo, val[:, None], 0.0)
+                tacc = tacc + jax.lax.dot_general(
+                    A, Bm, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            acc_ref[0, :, :] += tacc
+
+    return kernel
+
+
+def _sparse_pick_kernel():
+    def kernel(coff, cw, ct0, ct1, pool_blk, acc_blk, out_ref):
+        rc = pl.program_id(0)
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _init():
+            out_ref[...] = jnp.zeros((1, SPARSE_GRAN // 128, 128),
+                                     jnp.float32)
+
+        @pl.when((t >= ct0[rc]) & (t <= ct1[rc]))
+        def _gather():
+            base = t * TILE
+            col = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+            acc = acc_blk[0]                              # [128, 128] f32
+            rows = []
+            for r in range(SPARSE_GRAN // 128):
+                v = pool_blk[0, r, :]
+                doc = jax.lax.shift_right_logical(v, 8)
+                imp = jnp.bitwise_and(v, SPARSE_IMP_MAX)
+                rel = doc - base
+                ok = (imp > 0) & (rel >= 0) & (rel < TILE)
+                rel = jnp.where(ok, rel, 0)
+                hi = jax.lax.shift_right_logical(rel, 7)[:, None]
+                lo = jnp.bitwise_and(rel, 127)[:, None]
+                A = jnp.where(col == hi, 1.0, 0.0)
+                # gather-as-matmul: G[j] = acc[hi_j, :], then mask the lo
+                # lane — the transpose of the scatter trick, MXU + VPU only
+                G = jax.lax.dot_general(
+                    A, acc, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)   # [128, 128]
+                g = jnp.sum(jnp.where(col == lo, G, 0.0), axis=1)
+                rows.append(jnp.where(ok, g, 0.0)[None])
+            out_ref[0, :, :] += jnp.concatenate(rows, axis=0)
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("n_tiles",))
+def sparse_gather(coff, cw, ct0, ct1, pool, *, n_tiles: int):
+    """Cold-term eager sparse scoring: one scatter pass builds a dense
+    [n_tiles, 128, 128] per-doc accumulator from every dispatched slice
+    chunk (scatter-as-outer-product, exactly the build_columns idiom:
+    within a 16384-doc tile doc = hi*128 + lo, so A[lane, hi] and
+    B[lane, lo]*impact make the tile A^T @ B on the MXU), then a gather
+    pass reads the accumulated totals back at each chunk's own lanes.
+    Because slices from different terms scatter into the SAME accumulator,
+    the value read back at any lane is the doc's FULL cold contribution
+    for this dispatch — the host needs no posting-list walk, only the
+    bound-prune + exact top-k rescore (turbo.py `_sparse_contrib`).
+
+    coff [n_rc] i32 — pool granule index per 1024-lane chunk; granule 0 is
+        the reserved all-zero granule, where padding chunks point
+    cw   [n_rc] f32 — per-chunk dequant weight (idf * boost * slice
+        quantization scale); 0.0 for padding chunks
+    ct0/ct1 [n_rc] i32 — inclusive 16384-doc tile range covered by the
+        chunk's (sorted) docs; the empty range (1, 0) skips a chunk
+    pool [G, 8, 128] i32 — packed slice granules, ``doc << 8 | impact``
+        (uint8 impact, so doc ids must fit 23 bits — turbo.py gates)
+
+    Returns [n_rc, 8, 128] f32 — accumulated cold totals, lane-aligned
+    with the pool granules each chunk dispatched.
+    """
+    n_rc = coff.shape[0]
+    acc = pl.pallas_call(
+        _sparse_scatter_kernel(),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(n_tiles, n_rc),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, SPARSE_GRAN // 128, 128),
+                    lambda t, rc, coff, cw, ct0, ct1: (coff[rc], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 128, 128),
+                lambda t, rc, coff, cw, ct0, ct1: (t, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_tiles, 128, 128), jnp.float32),
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(coff, cw, ct0, ct1, pool)
+    fn = pl.pallas_call(
+        _sparse_pick_kernel(),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(n_rc, n_tiles),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, SPARSE_GRAN // 128, 128),
+                    lambda rc, t, coff, cw, ct0, ct1: (coff[rc], 0, 0)),
+                pl.BlockSpec(
+                    (1, 128, 128),
+                    lambda rc, t, coff, cw, ct0, ct1: (t, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, SPARSE_GRAN // 128, 128),
+                lambda rc, t, coff, cw, ct0, ct1: (rc, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_rc, SPARSE_GRAN // 128, 128),
+                                       jnp.float32),
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )
+    return fn(coff, cw, ct0, ct1, pool, acc)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def sparse_pool_update(pool, idx, upd):
+    """Write freshly built slice granules into the (donated) device pool
+    in place — the slice twin of the build kernel's aliased column
+    update. Padding rows point at granule 0 with all-zero payloads, so
+    the reserved zero granule stays zero."""
+    return pool.at[idx].set(upd)

@@ -62,8 +62,9 @@ from elasticsearch_tpu.parallel.blockmax import _host_block_scores
 from elasticsearch_tpu.parallel.compat import shard_map as _shard_map
 from elasticsearch_tpu.parallel.kernels import (
     BITSET_CLAUSES, BITSET_NEGS, COLSCALE, COLSCALE2, MAX_GROUP_ROWS,
-    N_CHUNKS, NCAND, ROWS_PER_STEP, SW, SW_WORD_ROWS, TILE, build_columns,
-    intersect_bitset, mask_chunk_counts, pack_presence_bits, sweep_rowmax,
+    N_CHUNKS, NCAND, ROWS_PER_STEP, SPARSE_GRAN, SPARSE_IMP_MAX, SW,
+    SW_WORD_ROWS, TILE, build_columns, intersect_bitset, mask_chunk_counts,
+    pack_presence_bits, sparse_gather, sparse_pool_update, sweep_rowmax,
     sweep_rowmax_bitset, sweep_rowmax_conj,
 )
 from elasticsearch_tpu.parallel.spmd import StackedBM25
@@ -240,6 +241,59 @@ def node_bitset_stats() -> dict:
         return dict(_NODE_BITSET_STATS)
 
 
+# ---- eager sparse impact tier (ES_TPU_SPARSE) ----
+#
+# Cold terms (df < COLD_DF) keep their postings as packed
+# ``doc << 8 | impact`` int32 lanes in a per-partition granule pool
+# (pre-multiplied idf-free BM25 impacts, uint8-quantized with a tracked
+# error bound — the BM25S eager-scoring representation). The pool is a
+# host-backed HBM region (scrubbed + repairable like the lane arrays),
+# and kernels.sparse_gather serves the cold side of every query from it,
+# retiring the _cold_contrib host fork from the serving path.
+
+_SPARSE_DOC_LIMIT = 1 << 23          # packed doc-id headroom in an int32
+_SPARSE_RC_BUCKETS = (2, 4, 8, 16, 32, 64, 128, 256)   # dispatch chunk
+#   counts are bucketed so kernels.sparse_gather sees a bounded shape set
+_SPARSE_UP_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128)     # granule-upload
+#   batch sizes (sparse_pool_update), padded toward the zero granule
+
+
+def _sparse_widths() -> Tuple[int, ...]:
+    """Slice-width ladder (ES_TPU_SPARSE_WIDTHS), each rung rounded up to
+    a granule multiple, ascending. A cold term's slice is padded to the
+    first rung >= its df so pool runs recycle at ladder widths only."""
+    raw = knob("ES_TPU_SPARSE_WIDTHS") or ""
+    ws = set()
+    for tok in str(raw).split(","):
+        tok = tok.strip()
+        if tok:
+            ws.add(max(SPARSE_GRAN,
+                       -(-int(tok) // SPARSE_GRAN) * SPARSE_GRAN))
+    return tuple(sorted(ws)) or (1024, 4096, 16384)
+
+
+# node-wide sparse-tier counters, folded into GET /_nodes/stats tpu_turbo
+# by serving.turbo_node_stats next to the bitset block; sparse_bytes is a
+# gauge-like running total of currently resident padded slice bytes
+# (evictions subtract), the rest are cumulative
+_NODE_SPARSE_STATS = {"sparse_slices": 0, "sparse_bytes": 0,
+                      "sparse_queries": 0,
+                      "sparse_fallbacks": 0}  # guarded by: _NODE_SPARSE_LOCK
+_NODE_SPARSE_LOCK = threading.Lock()
+
+
+def _node_sparse_add(key: str, n: int) -> None:
+    if n == 0:
+        return
+    with _NODE_SPARSE_LOCK:
+        _NODE_SPARSE_STATS[key] += n
+
+
+def node_sparse_stats() -> dict:
+    with _NODE_SPARSE_LOCK:
+        return dict(_NODE_SPARSE_STATS)
+
+
 class TurboBM25:
     """Single-partition serving engine over a StackedBM25 (S == 1).
 
@@ -342,11 +396,27 @@ class TurboBM25:
         # re-packed whenever cols_epoch moves
         self.bits = None
         self._bits_epoch = -1
+        # eager sparse impact slices (ES_TPU_SPARSE): cold terms keep
+        # packed (doc << 8 | impact) granules in a lazily grown device
+        # pool, built in the same ensure_columns pass as the columns, so
+        # the serving path never forks to the _cold_contrib host walk
+        self._sp_pool = None                  # [G, 8, 128] i32 device pool
+        self._sp_host: Optional[np.ndarray] = None   # authoritative mirror
+        self._sp_of: Dict[str, Tuple[int, int, int, float]] = {}
+        #   term -> (granule start, n granules, padded width, quant scale)
+        self._sp_lru: Dict[str, int] = {}
+        self._sp_free: Dict[int, List[int]] = {}     # run length -> starts
+        self._sp_next = 1                     # granule 0 reserved all-zero
+        self._sp_cap = max(2, min(int(hbm_budget_bytes) // 4, 64 << 20)
+                           // (SPARSE_GRAN * 4))
+        self._sp_ok = self.Dp <= _SPARSE_DOC_LIMIT
         self.stats = {"builds": 0, "build_s": 0.0, "fallbacks": 0,
                       "cold_queries": 0, "dispatches": 0, "degraded": 0,
                       "phrase_builds": 0, "bool_host": 0, "bool_device": 0,
                       "bitset_packs": 0, "bitset_gallop": 0,
-                      "bitset_blocks_skipped": 0, "bitset_bytes": 0}
+                      "bitset_blocks_skipped": 0, "bitset_bytes": 0,
+                      "sparse_queries": 0, "sparse_slices": 0,
+                      "sparse_bytes": 0, "sparse_fallbacks": 0}
         # HBM residency ledger: regions mirror hbm_bytes() exactly so the
         # telemetry cross-check can hold ledger == engine to the byte
         self._hbm = hbm_ledger.register_engine(self, "turbo")
@@ -358,6 +428,9 @@ class TurboBM25:
         self._hbm.set_region("cols_lo", self.cols_lo.nbytes)
         self._hbm.set_region("cols_bits",
                              0 if self.bits is None else self.bits.nbytes)
+        self._hbm.set_region(
+            "sparse_pool",
+            0 if self._sp_pool is None else self._sp_pool.nbytes)
         self._hbm.set_region("lane_docs", self.lane_docs.nbytes)
         self._hbm.set_region("lane_scores", self.lane_scores.nbytes)
         self._hbm.set_region("live", self.live.nbytes)
@@ -393,6 +466,7 @@ class TurboBM25:
     def hbm_bytes(self) -> int:
         return (self.cols_hi.nbytes + self.cols_lo.nbytes
                 + (0 if self.bits is None else self.bits.nbytes)
+                + (0 if self._sp_pool is None else self._sp_pool.nbytes)
                 + self.lane_docs.nbytes + self.lane_scores.nbytes
                 + self.live.nbytes)
 
@@ -431,6 +505,9 @@ class TurboBM25:
         self.qc_sizes = tuple(sorted(merged))
         hbm_ledger.note_primed("turbo", self.qc_sizes)
         hbm_ledger.note_primed("turbo_bitset", self.qc_sizes)
+        # the sparse gather's shape axis is its chunk-count bucket, whose
+        # ladder is static — priming it here keeps a cold start retrace-free
+        hbm_ledger.note_primed("turbo_sparse", _SPARSE_RC_BUCKETS)
 
     # ---------------- column cache ----------------
 
@@ -500,14 +577,25 @@ class TurboBM25:
         faults.fault_point("column_upload", self.part_id)
         self._tick += 1
         need: List[_TermInfo] = []
+        sparse_need: List[Tuple[str, _TermInfo]] = []
         for t in dict.fromkeys(terms):
             info = self._term(t)
             if info is None or info.df < self.cold_df:
+                if info is not None and info.df:
+                    sparse_need.append((t, info))
                 continue
             if t in self._slot_of:
                 self._lru[t] = self._tick
                 continue
             need.append((t, info))
+        # eager sparse slices ride the same upload pass as the columns: a
+        # cold start builds the cold tier's device representation here, so
+        # serving never primes it with host-path queries (ROADMAP item 2)
+        if sparse_need and self._sp_ok and bool(knob("ES_TPU_SPARSE")):
+            try:
+                self._ensure_sparse(sparse_need)
+            except DeviceFaultError:
+                pass   # query-time gather retries, then host-falls-back
         if not need:
             return
         protect = set(t for t, _ in need) | set(terms) | set(protect_extra)
@@ -749,6 +837,267 @@ class TurboBM25:
         np.add.at(acc, inv, np.concatenate(vals))
         return u, acc
 
+    # ---------------- eager sparse impact slices ----------------
+
+    def _sp_grow(self, new_g: int) -> None:
+        """Grow (or first-allocate) the granule pool to `new_g` granules.
+        The host mirror is authoritative — growth re-uploads it whole, so
+        mirror and device stay byte-identical for the scrubber."""
+        old = self._sp_host
+        host = np.zeros((new_g, SPARSE_GRAN // 128, 128), np.int32)
+        if old is not None:
+            host[: old.shape[0]] = old
+        self._sp_host = host
+        with faults.device_errors("sparse_gather", self.part_id):
+            self._sp_pool = jnp.asarray(host)
+        if old is None:
+            integrity.register_scrub_region(
+                self, "sparse_pool", lambda o: o._sp_pool,
+                expected=lambda o: o._sp_host,
+                repair=lambda o: setattr(
+                    o, "_sp_pool", jnp.asarray(o._sp_host)))
+        self._hbm.set_region("sparse_pool", self._sp_pool.nbytes)
+
+    def _sp_evict(self, term: str) -> None:
+        g0, n_g, w, _ = self._sp_of.pop(term)
+        self._sp_lru.pop(term, None)
+        self._sp_free.setdefault(n_g, []).append(g0)
+        # the stale granules stay in place (nothing references them, and
+        # host mirror == device still holds); reuse overwrites both sides
+        self.stats["sparse_bytes"] -= w * 4
+        _node_sparse_add("sparse_bytes", -w * 4)
+
+    def _reset_sparse(self) -> None:
+        """Drop every slice (fault containment / scrub repair): zero both
+        sides of the pool so mirror and device agree, and rebuild lazily."""
+        delta = -int(self.stats["sparse_bytes"])
+        self.stats["sparse_bytes"] = 0
+        _node_sparse_add("sparse_bytes", delta)
+        self._sp_of.clear()
+        self._sp_lru.clear()
+        self._sp_free.clear()
+        self._sp_next = 1
+        if self._sp_host is not None:
+            self._sp_host[:] = 0
+            self._sp_pool = jnp.asarray(self._sp_host)
+
+    def _sp_alloc(self, n_g: int, protect: set) -> int:
+        """One granule run for an `n_g`-granule slice, or -1. Tries the
+        width's free list, then the bump pointer (growing the pool toward
+        its cap), then LRU eviction. A victim's run is reusable only at
+        its own width — no coalescing; the ladder is small enough that
+        freed runs recycle quickly."""
+        free = self._sp_free.get(n_g)
+        if free:
+            return free.pop()
+        cur = 0 if self._sp_pool is None else self._sp_pool.shape[0]
+        if self._sp_next + n_g > cur and cur < self._sp_cap:
+            self._sp_grow(min(self._sp_cap,
+                              max(cur * 2, self._sp_next + n_g, 64)))
+            cur = self._sp_pool.shape[0]
+        if self._sp_next + n_g <= cur:
+            g0 = self._sp_next
+            self._sp_next += n_g
+            return g0
+        for t in sorted(self._sp_lru, key=self._sp_lru.get):
+            if t in protect or t not in self._sp_of:
+                continue
+            self._sp_evict(t)
+            free = self._sp_free.get(n_g)
+            if free:
+                return free.pop()
+        return -1
+
+    def _ensure_sparse(self, pairs: Sequence[Tuple[str, _TermInfo]]) -> bool:
+        """Build device slices for the given cold (term, info) pairs:
+        pack ``doc << 8 | impact`` granules on the host (the mirror is the
+        scrubber's truth), then batch-write them into the donated device
+        pool. Returns False when any term cannot be sliced (df above the
+        ladder, or pool pressure with everything protected) — the caller
+        host-scores the whole batch so bound math never mixes tiers.
+        Impacts are uint8-quantized on a per-term scale smax/255; rounding
+        is forced to >= 1 so a real posting never vanishes, which widens
+        the per-posting error to one full quant step (the lo >= 1 idiom of
+        the column build, mirrored in _sparse_contrib's slack)."""
+        if not self._sp_ok:
+            return False
+        widths = _sparse_widths()
+        self._tick += 1
+        need: List[Tuple[str, _TermInfo, int]] = []
+        protect = set()
+        for t, info in pairs:
+            protect.add(t)
+            if t in self._sp_of:
+                self._sp_lru[t] = self._tick
+                continue
+            w = next((w for w in widths if w >= info.df), None)
+            if w is None:
+                return False
+            need.append((t, info, w))
+        if not need:
+            return True
+        fp = self.fp
+        idx_l, upd_l = [], []
+        try:
+            for t, info, w in need:
+                n_g = w // SPARSE_GRAN
+                g0 = self._sp_alloc(n_g, protect)
+                if g0 < 0:
+                    return False
+                lo = int(fp.post_start[info.ord])
+                hi = int(fp.post_start[info.ord + 1])
+                docs = np.asarray(fp.post_doc[lo:hi], np.int64)
+                lanes = self._host_scores[
+                    info.row_start: info.row_start + info.n_rows
+                ].ravel()[: hi - lo].astype(np.float64)
+                sscale = max(float(info.smax), 1e-9) / SPARSE_IMP_MAX
+                q = np.clip(np.rint(lanes / sscale),
+                            1, SPARSE_IMP_MAX).astype(np.int64)
+                buf = np.zeros(w, np.int64)
+                buf[: hi - lo] = (docs << 8) | q
+                gran = buf.astype(np.int32).reshape(
+                    n_g, SPARSE_GRAN // 128, 128)
+                self._sp_host[g0: g0 + n_g] = gran
+                self._sp_of[t] = (g0, n_g, w, sscale)
+                self._sp_lru[t] = self._tick
+                idx_l.append(np.arange(g0, g0 + n_g, dtype=np.int32))
+                upd_l.append(gran)
+                self.stats["sparse_slices"] += 1
+                self.stats["sparse_bytes"] += w * 4
+                _node_sparse_add("sparse_slices", 1)
+                _node_sparse_add("sparse_bytes", w * 4)
+                metrics.observe("sparse_slice_width", w)
+            idx = np.concatenate(idx_l)
+            upd = np.concatenate(upd_l, axis=0)
+            nb = next((b for b in _SPARSE_UP_BUCKETS if b >= len(idx)),
+                      -(-len(idx) // _SPARSE_UP_BUCKETS[-1])
+                      * _SPARSE_UP_BUCKETS[-1])
+            pad = nb - len(idx)
+            idx = np.concatenate([idx, np.zeros(pad, np.int32)])
+            upd = np.concatenate(
+                [upd, np.zeros((pad, SPARSE_GRAN // 128, 128), np.int32)])
+            with faults.device_errors("sparse_gather", self.part_id):
+                self._sp_pool = sparse_pool_update(
+                    self._sp_pool, jnp.asarray(idx), jnp.asarray(upd))
+        except DeviceFaultError:
+            # a half-written pool would break the mirror == device
+            # invariant the scrubber enforces — drop everything
+            self._reset_sparse()
+            raise
+        self._hbm.set_region("sparse_pool", self._sp_pool.nbytes)
+        return True
+
+    def _sparse_gather_dispatch(self, cold_terms):
+        """Device cold-side scoring: ensure slices, assemble the chunk
+        dispatch, run kernels.sparse_gather, and map the gathered totals
+        back onto each term's posting order. Returns None when the batch
+        cannot be sliced; raises DeviceFaultError on device faults (the
+        caller contains both). Otherwise (docs, contrib, slack) where
+        docs/contrib mirror _cold_contrib's unique-doc enumeration and
+        slack bounds |contrib - exact| (quantization + f32 accumulation,
+        the e_q certificate style)."""
+        if not self._sp_ok:
+            return None
+        if not self._ensure_sparse([(t, i) for t, _b, i in cold_terms]):
+            return None
+        fp = self.fp
+        coff: List[int] = []
+        cw: List[float] = []
+        ct0: List[int] = []
+        ct1: List[int] = []
+        spans: List[Tuple[int, int, int]] = []
+        slack = 1e-7
+        for t, b, info in cold_terms:
+            g0, n_g, _w, sscale = self._sp_of[t]
+            wt = float(info.idf * b)
+            lo = int(fp.post_start[info.ord])
+            c0 = len(coff)
+            n_used = -(-info.df // SPARSE_GRAN)
+            for j in range(n_used):
+                s = lo + j * SPARSE_GRAN
+                e = min(lo + (j + 1) * SPARSE_GRAN, lo + info.df)
+                coff.append(g0 + j)
+                cw.append(wt * sscale)
+                ct0.append(int(fp.post_doc[s]) // TILE)
+                ct1.append(int(fp.post_doc[e - 1]) // TILE)
+            spans.append((c0, info.df, lo))
+            # one posting per (term, doc): quantization error <= one full
+            # step per term, plus a generous f32-accumulation margin
+            slack += abs(wt) * (sscale
+                                + 3e-6 * max(float(info.smax), sscale))
+        if len(coff) > _SPARSE_RC_BUCKETS[-1]:
+            return None
+        rcb = next(b for b in _SPARSE_RC_BUCKETS if b >= len(coff))
+        pad = rcb - len(coff)
+        first = hbm_ledger.note_dispatch("turbo_sparse", rcb)
+        t0 = time.monotonic()
+        with faults.device_errors("sparse_gather", self.part_id):
+            out = sparse_gather(
+                jnp.asarray(np.asarray(coff + [0] * pad, np.int32)),
+                jnp.asarray(np.asarray(cw + [0.0] * pad, np.float32)),
+                jnp.asarray(np.asarray(ct0 + [1] * pad, np.int32)),
+                jnp.asarray(np.asarray(ct1 + [0] * pad, np.int32)),
+                self._sp_pool, n_tiles=self.Dp // TILE)
+            flat = np.asarray(out).reshape(rcb * SPARSE_GRAN)
+        if first:
+            hbm_ledger.note_compile_done("turbo_sparse", rcb,
+                                         time.monotonic() - t0)
+        docs_l, vals_l = [], []
+        for c0, df, lo in spans:
+            docs_l.append(np.asarray(fp.post_doc[lo: lo + df], np.int64))
+            base = c0 * SPARSE_GRAN
+            vals_l.append(flat[base: base + df])
+        docs = np.concatenate(docs_l)
+        vals = np.concatenate(vals_l).astype(np.float64)
+        # a doc shared by several dispatched slices reads the SAME
+        # accumulator cell at every occurrence — first occurrence wins,
+        # exactly _cold_contrib's unique-doc enumeration
+        u, fidx = np.unique(docs, return_index=True)
+        return u, vals[fidx], float(slack)
+
+    def _sparse_contrib(self, cold_terms):
+        """Device twin of _cold_contrib with per-partition containment:
+        (docs, contrib, slack). Any fault or unsliceable batch falls back
+        to the exact host enumeration with slack 0 — downstream pruning
+        then evaluates the IDENTICAL expression the host path uses, so
+        containment is bit-identical by construction."""
+        try:
+            faults.fault_point("sparse_gather", self.part_id)
+            res = self._sparse_gather_dispatch(cold_terms)
+        except DeviceFaultError:
+            res = None
+        if res is None:
+            self.stats["sparse_fallbacks"] += 1
+            _node_sparse_add("sparse_fallbacks", 1)
+            u, acc = self._cold_contrib(cold_terms)
+            return u, acc, 0.0
+        return res
+
+    def sparse_hot_terms(self) -> List[str]:
+        """Terms with a resident sparse slice — the warm-handoff payload a
+        relocation source ships so its target can pre-slice the cold tier
+        (indices/shard_service.py warm_relocation_handoff)."""
+        return sorted(self._sp_of)
+
+    def prewarm_sparse(self, terms: Sequence[str]) -> int:
+        """Build slices for the given terms ahead of traffic (relocation
+        warm handoff). Best-effort; returns how many slices are resident
+        afterwards among the requested cold terms."""
+        if not (self._sp_ok and bool(knob("ES_TPU_SPARSE"))):
+            return 0
+        pairs = []
+        for t in dict.fromkeys(terms):
+            info = self._term(t)
+            if info is not None and info.df and info.df < self.cold_df:
+                pairs.append((t, info))
+        if not pairs:
+            return 0
+        try:
+            self._ensure_sparse(pairs)
+        except DeviceFaultError:
+            pass
+        return sum(1 for t, _ in pairs if t in self._sp_of)
+
     def prebuild_columns(self) -> int:
         """Build every colizable term's column now (capacity-capped, by
         df desc). Serving warms lazily; benchmarks and latency-sensitive
@@ -830,9 +1179,11 @@ class TurboBM25:
         if not flat:
             return [(np.zeros((n, k), np.float32), np.zeros((n, k), np.int32))
                     for _, n in spans]
+        # cold terms ride along: ensure_columns builds their eager sparse
+        # slices in the same upload pass the columns use
         self.ensure_columns(
             [t for q in flat for t, _ in q
-             if (i := self._term(t)) is not None and i.df >= self.cold_df])
+             if self._term(t) is not None])
 
         # pass 1: sweep -> row pick, both on device, dispatched async per
         # chunk; only the packed [QC, n_rows+1] pick output crosses the
@@ -998,8 +1349,14 @@ class TurboBM25:
         cold_docs = np.empty(0, np.int64)
         cold_s = np.empty(0, np.float32)
         if cold_terms:
-            self.stats["cold_queries"] += 1
-            docs_c, contrib = self._cold_contrib(cold_terms)
+            if self._sp_ok and bool(knob("ES_TPU_SPARSE")):
+                self.stats["sparse_queries"] += 1
+                _node_sparse_add("sparse_queries", 1)
+                docs_c, contrib, slack = self._sparse_contrib(cold_terms)
+            else:
+                self.stats["cold_queries"] += 1
+                docs_c, contrib = self._cold_contrib(cold_terms)
+                slack = 0.0
             lv = self._live_host[docs_c] > 0
             docs_c, contrib = docs_c[lv], contrib[lv]
             if col_terms:
@@ -1009,8 +1366,12 @@ class TurboBM25:
                         len(cand_s) - k])
                 col_const = sum(info.idf * b * info.smax
                                 for _, b, info in col_terms)
-                # float64 contrib + margin keeps this a true upper bound
-                survivors = docs_c[contrib + col_const + 1e-5 >= kth_0]
+                # float64 contrib + margin keeps this a true upper bound;
+                # slack covers the sparse tier's quantization so the
+                # survivor set is a SUPERSET of the host path's — extras
+                # are exact-rescored and provably below the k-th score
+                survivors = docs_c[contrib + slack + col_const + 1e-5
+                                   >= kth_0]
                 if len(survivors):
                     cold_docs = survivors
                     cold_s = self._exact_scores(qterms, cold_docs)
@@ -1142,8 +1503,9 @@ class TurboBM25:
                 continue
             ens_terms += [t for t, _, _ in r.conj]
             ens_terms += [t for t, _ in r.filters]
-            ens_terms += [t for t, _, i in r.should
-                          if i.df >= self.cold_df]
+            # cold SHOULD terms ride along: ensure_columns skips them for
+            # the dense cache but its sparse hook slices them eagerly
+            ens_terms += [t for t, _, _ in r.should]
             ens_terms += [t for t, i in r.must_not
                           if i.df >= self.cold_df]
             for terms, _, _, pinfo, _ in r.phrases:
@@ -1548,8 +1910,14 @@ class TurboBM25:
         cold_docs = np.empty(0, np.int64)
         cold_s = np.empty(0, np.float32)
         if cold_should:
-            self.stats["cold_queries"] += 1
-            docs_c, contrib = self._cold_contrib(cold_should)
+            if self._sp_ok and bool(knob("ES_TPU_SPARSE")):
+                self.stats["sparse_queries"] += 1
+                _node_sparse_add("sparse_queries", 1)
+                docs_c, contrib, slack = self._sparse_contrib(cold_should)
+            else:
+                self.stats["cold_queries"] += 1
+                docs_c, contrib = self._cold_contrib(cold_should)
+                slack = 0.0
             lv = self._live_host[docs_c] > 0
             docs_c, contrib = docs_c[lv], contrib[lv]
             kth_0 = 0.0
@@ -1557,7 +1925,9 @@ class TurboBM25:
                 kth_0 = float(np.partition(cand_s, len(cand_s) - k)[
                     len(cand_s) - k])
             col_const = sum(abs(w) * sm for _, w, sm in scoring)
-            survivors = docs_c[contrib + col_const + 1e-5 >= kth_0]
+            # slack widens the bound for sparse quantization: superset of
+            # the host path's survivors, extras exact-rescored below
+            survivors = docs_c[contrib + slack + col_const + 1e-5 >= kth_0]
             if len(survivors):
                 s, m = self._exact_bool(r, survivors)
                 keep = m & (s > 0)
@@ -2088,8 +2458,7 @@ class ShardedTurbo:
             try:
                 t.ensure_columns(
                     [tm for q in flat for tm, _ in q
-                     if (inf := t._term(tm)) is not None
-                     and inf.df >= t.cold_df])
+                     if t._term(tm) is not None])
                 self._refresh_part(i)
             except DeviceFaultError as e:
                 failed[i] = e

@@ -351,11 +351,14 @@ _TURBO_NODE_LOCK = threading.Lock()
 
 
 def turbo_node_stats() -> dict:
-    from elasticsearch_tpu.parallel.turbo import node_bitset_stats
+    from elasticsearch_tpu.parallel.turbo import (
+        node_bitset_stats, node_sparse_stats,
+    )
 
     with _TURBO_NODE_LOCK:
         out = dict(_TURBO_NODE_STATS)
     out.update(node_bitset_stats())
+    out.update(node_sparse_stats())
     return out
 
 
@@ -513,6 +516,20 @@ class TurboEngine:
             t.extend_qc_sizes(sizes)
         if self._sharded is not None:
             self._sharded.extend_qc_sizes(sizes)
+
+    def sparse_hot_terms(self) -> list:
+        """Union of the partitions' resident eager-sparse cold-term
+        slices — the warm-relocation handoff payload (a target rebuilds
+        these via prewarm_sparse before taking traffic)."""
+        out = set()
+        for t in self.turbos:
+            out.update(t.sparse_hot_terms())
+        return sorted(out)
+
+    def prewarm_sparse(self, terms) -> int:
+        """Build sparse slices for `terms` on every partition ahead of
+        traffic; returns total slices resident afterwards."""
+        return sum(t.prewarm_sparse(terms) for t in self.turbos)
 
     def _host_tier_many(self, batches, k, check):
         """Whole-engine host-exact tier (circuit open / catastrophic

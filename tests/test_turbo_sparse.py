@@ -1,0 +1,255 @@
+"""Eager sparse impact slice differential suite (PR 17).
+
+Cold terms (df < COLD_DF) no longer fork to the `_cold_contrib` host walk
+on the serving path: at column-upload time each cold query term gets an
+eagerly-scored sparse slice — packed ``doc << 8 | impact`` granules with a
+per-term uint8 quantization scale — and `kernels.sparse_gather` scatters
+them into a dense per-tile accumulator on device. The contract: the device
+contribution plus its tracked error bound (`slack`, the cold twin of the
+`e_q` certificate arithmetic) is a true upper bound, so the bound-pruned
+survivor set is a SUPERSET of the host path's, every survivor is exact
+host rescored, and top-k stays BIT-identical to the host reference on
+every route — solo, fused S > 1, bool with cold clauses, the host A/B
+(`ES_TPU_SPARSE=0`), certificate fallback, injected `sparse_gather`
+faults, and an HBM scrub cycle repairing a corrupted slice pool.
+
+Runs on the host-simulated 8-device CPU mesh from tests/conftest.py
+(Pallas kernels interpret on CPU)."""
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.common import faults, integrity
+from elasticsearch_tpu.common.settings import knob
+from elasticsearch_tpu.parallel.turbo import SPARSE_GRAN, _sparse_widths
+
+from test_turbo_bitset import _pcorpus, _turbo, _fused, _assert_identical
+
+pytestmark = pytest.mark.multidevice
+
+K = 10
+# _pcorpus(3000, 40, 7) dfs run ~2886 down to ~173; cold_df=800 leaves
+# terms t8.. cold, t0..t7 colized — queries below straddle the boundary
+COLD_DF = 800
+
+
+def _queries():
+    qs = [[(f"t{i}", 1.0), (f"t{i + 11}", 0.7)] for i in range(0, 20, 3)]
+    qs.append([("t30", 1.0), ("t35", 1.0)])            # cold-only
+    qs.append([("t31", 2.0)])                          # single cold term
+    qs.append([("t0", 1.0), ("t25", 1.0), ("t38", 0.5)])   # mixed
+    qs.append([("t1", 1.0), ("t2", 0.5)])              # colized-only
+    qs.append([("absent", 1.0), ("t33", 1.0)])         # unknown + cold
+    return qs
+
+
+def test_sparse_solo_bit_identical():
+    t = _turbo(_pcorpus(3000, 40, 7), 3000, cold_df=COLD_DF)
+    qs = _queries()
+    got = t.search_many([qs], k=K)[0]
+    want = t.search_many_host([qs], k=K)[0]
+    _assert_identical(got, want, "sparse solo vs host")
+    assert t.stats["cold_queries"] == 0, "host cold fork still serving"
+    assert t.stats["sparse_queries"] > 0, "sparse route never engaged"
+    assert t.stats["sparse_slices"] > 0, "no slices built"
+    assert t.stats["sparse_fallbacks"] == 0
+    assert t.stats["sparse_bytes"] > 0
+    assert t._sp_pool is not None and t._sp_host is not None
+    # every resident slice is granule-aligned on a declared ladder rung
+    widths = _sparse_widths()
+    for g0, n_g, w, sscale in t._sp_of.values():
+        assert w in widths and w == n_g * SPARSE_GRAN and sscale > 0
+
+
+def test_sparse_off_ab_identical(monkeypatch):
+    """ES_TPU_SPARSE=0 restores the host cold fork verbatim — same bits,
+    today's counters."""
+    fp = _pcorpus(3000, 40, 7)
+    qs = _queries()
+    on = _turbo(fp, 3000, cold_df=COLD_DF)
+    got_on = on.search_many([qs], k=K)[0]
+    monkeypatch.setenv("ES_TPU_SPARSE", "0")
+    off = _turbo(fp, 3000, cold_df=COLD_DF)
+    got_off = off.search_many([qs], k=K)[0]
+    _assert_identical(got_on, got_off, "sparse on vs off A/B")
+    _assert_identical(got_off, off.search_many_host([qs], k=K)[0],
+                      "sparse off vs host")
+    assert off.stats["cold_queries"] > 0
+    assert off.stats["sparse_queries"] == 0
+    assert off.stats["sparse_slices"] == 0 and off.stats["sparse_bytes"] == 0
+    assert off._sp_pool is None, "slices built despite ES_TPU_SPARSE=0"
+
+
+def test_sparse_bool_bit_identical():
+    """Bool route: cold SHOULD terms score via the sparse tier; cold
+    must/must_not clauses keep their exact host routing — all specs stay
+    bit-identical to search_bool_host."""
+    t = _turbo(_pcorpus(3000, 40, 7), 3000, cold_df=COLD_DF)
+    specs = [
+        {"must": [("t1", 1.0)], "should": [("t30", 1.0), ("t35", 0.5)]},
+        {"must": [("t25", 1.0), ("t3", 1.0)], "must_not": ["t33"]},
+        {"filter": ["t4"], "should": [("t38", 1.0)]},
+        {"must": [("t2", 1.0)], "should": [("t8", 1.0), ("t31", 1.0)]},
+        {"should": [("t28", 1.0), ("t36", 2.0)]},      # all-cold scoring
+        {"must": [("t34", 1.0)], "must_not": ["t0"]},  # cold must
+    ]
+    got = t.search_bool(specs, k=K)
+    want = t.search_bool_host(specs, k=K)
+    _assert_identical(got, want, "sparse bool vs host")
+    assert t.stats["sparse_queries"] > 0, "bool cold side never sparse"
+    assert t.stats["cold_queries"] == 0
+
+
+def test_sparse_fused_bit_identical():
+    """S=3 fused dispatch (different sizes, vocabularies, df spectra,
+    therefore different per-partition slice pools) against each
+    partition's host route, plus the ledger == hbm_bytes cross-check."""
+    eng = _fused([(1500, _pcorpus(1500, 40, 1)),
+                  (900, _pcorpus(900, 56, 2)),
+                  (2100, _pcorpus(2100, 32, 3))], cold_df=300)
+    st = eng._fused()
+    qs = [[("t1", 1.0), ("t20", 1.0)], [("t25", 1.0), ("t30", 0.5)],
+          [("t2", 1.0)], [("t28", 1.0), ("t31", 1.0), ("t3", 0.2)]]
+    per = st.search_many([qs], k=K)
+    for si, t in enumerate(st.turbos):
+        _assert_identical(per[si][0], t.search_many_host([qs], k=K)[0],
+                          f"fused partition {si} vs host")
+    assert sum(t.stats["sparse_queries"] for t in st.turbos) > 0
+    assert all(t.stats["cold_queries"] == 0 for t in st.turbos)
+    # ledger cross-check: the slice pool is a ledgered region, and each
+    # engine's ledgered occupancy stays byte-identical to hbm_bytes()
+    for t in st.turbos:
+        assert t._hbm.total_bytes() == t.hbm_bytes()
+        if t._sp_pool is not None:
+            assert t._sp_pool.nbytes > 0
+    assert eng.hbm_bytes() == (sum(t.hbm_bytes() for t in st.turbos)
+                               + st.hbm_bytes())
+
+
+def test_sparse_widths_ladder(monkeypatch):
+    """A custom ES_TPU_SPARSE_WIDTHS ladder is honored (rounded up to
+    granule multiples) and stays bit-identical; a term above the top rung
+    falls back to the exact host walk."""
+    monkeypatch.setenv("ES_TPU_SPARSE_WIDTHS", "1024,2048")
+    assert _sparse_widths() == (1024, 2048)
+    fp = _pcorpus(3000, 40, 7)
+    t = _turbo(fp, 3000, cold_df=2500)   # t2 (df~1892) cold, > 1024 rung
+    qs = [[("t2", 1.0), ("t30", 1.0)], [("t35", 1.0), ("t38", 1.0)]]
+    got = t.search_many([qs], k=K)[0]
+    _assert_identical(got, t.search_many_host([qs], k=K)[0],
+                      "custom ladder vs host")
+    assert all(w in (1024, 2048) for _, _, w, _ in t._sp_of.values())
+    # df above the ladder: the whole batch host-falls-back, still counted
+    monkeypatch.setenv("ES_TPU_SPARSE_WIDTHS", "1024")
+    t2 = _turbo(fp, 3000, cold_df=2500)
+    got2 = t2.search_many([qs[:1]], k=K)[0]
+    _assert_identical(got2, t2.search_many_host([qs[:1]], k=K)[0],
+                      "over-ladder fallback vs host")
+    assert t2.stats["sparse_fallbacks"] > 0
+
+
+def test_sparse_certificate_fallback():
+    """force_cert_fail (the bool-path certificate test hook) discards the
+    device collection on specs whose cold SHOULD side went through the
+    sparse tier; the exact fallback still agrees bit-for-bit."""
+    t = _turbo(_pcorpus(2200, 40, 9), 2200, cold_df=600)
+    specs = [{"must": [("t0", 1.0)], "should": [("t30", 1.0)]},
+             {"must": [("t2", 1.0)], "should": [("t25", 1.0),
+                                                ("t33", 0.5)]}]
+    want = t.search_bool_host(specs, k=K)
+    fb0 = t.stats["fallbacks"]
+    try:
+        t.force_cert_fail = True
+        got = t.search_bool(specs, k=K)
+    finally:
+        t.force_cert_fail = False
+    _assert_identical(got, want, "cert-fail vs host")
+    assert t.stats["fallbacks"] > fb0
+    assert t.stats["sparse_queries"] > 0
+
+
+@pytest.mark.faults
+def test_sparse_fault_contained_per_partition():
+    """An injected sparse_gather fault on one partition host-scores that
+    partition's cold side only — results stay bit-identical, the fallback
+    is counted, and a clean retry serves the device route again."""
+    eng = _fused([(700, _pcorpus(700, 40, 12)),
+                  (900, _pcorpus(900, 32, 13))], cold_df=250)
+    qs = [[("t20", 1.0), ("t25", 1.0)], [("t1", 1.0), ("t28", 0.5)]]
+    want = eng._merge3([t.search_many_host([qs], k=K)[0]
+                        for t in eng.turbos], len(qs), K)
+    fb0 = eng.turbos[1].stats["sparse_fallbacks"]
+    with faults.inject("sparse_gather#1:raise@1"):
+        got = eng.search_many([qs], k=K)[0]
+    for g, w, name in zip(got, want, ("scores", "parts", "ords")):
+        assert np.array_equal(np.asarray(g), np.asarray(w)), name
+    assert eng.turbos[1].stats["sparse_fallbacks"] > fb0, \
+        "faulted partition never fell back"
+    clean = eng.search_many([qs], k=K)[0]
+    for g, w, name in zip(clean, want, ("scores", "parts", "ords")):
+        assert np.array_equal(np.asarray(g), np.asarray(w)), name
+
+
+@pytest.mark.faults
+def test_sparse_scrub_bitflip_repair():
+    """PR-15 integrity plane over the slice pool: an injected hbm_region
+    flip on sparse_pool is detected by the scrubber, repaired from the
+    host mirror, and the repaired engine answers bit-identically."""
+    fp = _pcorpus(1400, 36, 14)
+    qs = [[("t20", 1.0), ("t25", 1.0)], [("t1", 1.0), ("t28", 0.5)]]
+    control = _turbo(fp, 1400, cold_df=300)
+    want = control.search_many([qs], k=K)[0]
+    _assert_identical(want, control.search_many_host([qs], k=K)[0],
+                      "control")
+
+    integrity.reset_scrub_for_tests()      # only the engine below scrubs
+    t = _turbo(fp, 1400, cold_df=300)
+    t.search_many([qs], k=K)               # builds slices, registers region
+    assert t._sp_pool is not None
+
+    def cycle():
+        return [integrity.scrub_once()
+                for _ in range(integrity.scrub_registry_size())]
+
+    cycle()                                # baseline pass: all clean
+    m0 = integrity.integrity_stats()["scrub_mismatches"]
+    with faults.inject("hbm_region#sparse_pool:raise@1x1"):
+        results = cycle()
+    hit = [r for r in results if r and r["result"] == "mismatch"]
+    assert len(hit) == 1 and hit[0]["region"].endswith(".sparse_pool")
+    st = integrity.integrity_stats()
+    assert st["scrub_mismatches"] == m0 + 1
+    assert st["scrub_repairs"] >= 1
+    _assert_identical(t.search_many([qs], k=K)[0], want,
+                      "repaired sparse engine vs control")
+    cycle()                                # repair re-baselined the region
+    assert integrity.integrity_stats()["scrub_mismatches"] == m0 + 1
+
+
+def test_sparse_prewarm_and_hot_terms():
+    """The relocation warm-handoff surface: sparse_hot_terms reports the
+    resident slice set; prewarm_sparse rebuilds it on a cold engine so
+    the first query after a move needs no slice build."""
+    fp = _pcorpus(2000, 40, 15)
+    src = _turbo(fp, 2000, cold_df=400)
+    qs = [[("t20", 1.0), ("t30", 1.0)], [("t25", 1.0)]]
+    src.search_many([qs], k=K)
+    hot = src.sparse_hot_terms()
+    assert hot, "no slices resident after cold-term traffic"
+
+    dst = _turbo(fp, 2000, cold_df=400)
+    n = dst.prewarm_sparse(hot)
+    assert n == len(hot)
+    assert dst.sparse_hot_terms() == hot
+    s0 = dst.stats["sparse_slices"]
+    got = dst.search_many([qs], k=K)[0]
+    _assert_identical(got, src.search_many_host([qs], k=K)[0],
+                      "prewarmed vs host")
+    assert dst.stats["sparse_slices"] == s0, "prewarmed slices rebuilt"
+    # colized terms never slice; unknown terms are ignored
+    assert dst.prewarm_sparse(["t0", "absent"]) == 0
+
+
+def test_sparse_knob_defaults():
+    assert bool(knob("ES_TPU_SPARSE")) is True
+    assert _sparse_widths() == (1024, 4096, 16384)
