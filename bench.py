@@ -894,6 +894,55 @@ def main():
     else:
         detail["config3_phrase"] = {"skipped": "budget"}
 
+    # ================= config 6: analytics (device agg tier) ==========
+    if left() > 120:
+        try:
+            from elasticsearch_tpu.search import agg_device
+            import elasticsearch_tpu.search.aggregations as agg_mod
+
+            # interpret-mode Pallas on CPU can't sweep 10M-doc pair
+            # columns in budget; the real corpus size runs on TPU only
+            n_agg = N_DOCS if detail["device"] == "tpu" \
+                else min(N_DOCS, 200_000)
+            log(f"config6 analytics ({n_agg} docs)...")
+            actx = _synth_agg_leaf(n_agg, seed=29, vocab=256)
+            arng = np.random.default_rng(31)
+            amasks = [arng.random(n_agg) < 0.05 for _ in range(8)]
+            min_docs_prev = agg_mod.AGG_DEVICE_MIN_DOCS
+            agg_mod.AGG_DEVICE_MIN_DOCS = 1
+            a0 = dict(agg_device.agg_stats())
+            _run_aggs(actx, amasks[:1])          # warm: layouts + traces
+            t0 = time.time()
+            dev_out = _run_aggs(actx, amasks)
+            agg_wall = time.time() - t0
+            a1 = dict(agg_device.agg_stats())
+            agg_mod.AGG_DEVICE_MIN_DOCS = 1 << 60
+            t0 = time.time()
+            host_out = _run_aggs(actx, amasks[:2])
+            host_qps = 2 / (time.time() - t0)
+            agg_mod.AGG_DEVICE_MIN_DOCS = min_docs_prev
+            agree6 = float(np.mean([d == h for d, h
+                                    in zip(dev_out[:2], host_out)]))
+            detail["config6_analytics"] = {
+                "qps": round(len(amasks) / agg_wall, 1),
+                "host_qps": round(host_qps, 1),
+                "vs_host": round(len(amasks) / agg_wall / host_qps, 2),
+                "agreement": agree6,
+                "n_docs": n_agg,
+                "mix": "Zipf terms+stats / 7d date_histogram+sum, "
+                       "5% selectivity masks",
+                "tpu_agg": {k: a1[k] - a0[k] for k in
+                            ("agg_queries", "agg_device_dispatches",
+                             "agg_host_fallbacks", "agg_bytes")},
+                "agg_hbm_bytes": int(agg_device.default_engine().hbm_bytes()),
+            }
+            log(f"config6: {len(amasks) / agg_wall:.1f} agg qps "
+                f"(agreement {agree6})")
+        except Exception as e:   # noqa: BLE001
+            detail["config6_analytics"] = {"error": repr(e)[:300]}
+    else:
+        detail["config6_analytics"] = {"skipped": "budget"}
+
     emit(partial=False)
 
 
@@ -1174,6 +1223,157 @@ def dryrun_sparse() -> int:
     }), flush=True)
     log(f"dryrun_sparse: identical={identical} cold_q={cold_q} "
         f"sparse_q={sparse_q} retraces={retraces} ab_ok={ab_ok}")
+    return 0 if ok else 1
+
+
+def _synth_agg_leaf(n_docs: int, seed: int = 23, vocab: int = 64):
+    """Synthetic analytics leaf: Zipf keyword tags (1-2 per doc, deduped
+    per-doc-sorted CSR like the real builder), a 90-day timestamp column,
+    and a price column with exists gaps — enough shape to drive
+    terms/date_histogram and metric sub-aggs without paying an
+    IndexService build at bench scale. Returns an AggContext."""
+    from types import SimpleNamespace
+
+    from elasticsearch_tpu.index.segment import KeywordColumn, NumericColumn
+    from elasticsearch_tpu.search.aggregations import AggContext
+
+    rng = np.random.default_rng(seed)
+    probs = 1.0 / np.arange(1, vocab + 1) ** 1.1
+    probs /= probs.sum()
+    n_tags = 1 + (rng.random(n_docs) < 0.33).astype(np.int64)
+    doc_of = np.repeat(np.arange(n_docs, dtype=np.int64), n_tags)
+    draws = rng.choice(vocab, size=len(doc_of), p=probs).astype(np.int64)
+    pair = np.unique(doc_of * vocab + draws)   # doc-major, ord asc, deduped
+    all_ords = (pair % vocab).astype(np.int32)
+    counts = np.bincount(pair // vocab, minlength=n_docs)
+    ord_start = np.concatenate([[0], np.cumsum(counts)])
+    kc = KeywordColumn(
+        terms=[f"t{i}" for i in range(vocab)],
+        term_to_ord={f"t{i}": i for i in range(vocab)},
+        ords=all_ords[ord_start[:-1]].astype(np.int32),
+        max_ords=all_ords[ord_start[1:] - 1].astype(np.int32),
+        exists=np.ones(n_docs, bool),
+        ord_start=ord_start, all_ords=all_ords)
+
+    ts = (1_600_000_000_000
+          + rng.integers(0, 90 * 86_400_000, size=n_docs)).astype(np.float64)
+    tcol = NumericColumn(values=ts, max_values=ts,
+                         exists=np.ones(n_docs, bool),
+                         value_start=np.arange(n_docs + 1, dtype=np.int64),
+                         all_values=ts)
+
+    p_exists = rng.random(n_docs) < 0.8
+    price = np.round(rng.normal(40, 12, size=n_docs), 2)
+    pcol = NumericColumn(
+        values=np.where(p_exists, price, 0.0),
+        max_values=np.where(p_exists, price, 0.0), exists=p_exists,
+        value_start=np.concatenate(
+            [[0], np.cumsum(p_exists.astype(np.int64))]),
+        all_values=price[p_exists])
+
+    seg = SimpleNamespace(n_docs=n_docs, keyword={"tag": kc},
+                          numeric={"ts": tcol, "price": pcol}, _device={})
+    leaf = SimpleNamespace(segment=seg, n_docs=n_docs)
+    return AggContext(leaf=leaf, mapper=None, executor=None,
+                      live=np.ones(n_docs, bool))
+
+
+AGG_BENCH_SPEC = {
+    "tags": {"terms": {"field": "tag", "size": 64},
+             "aggs": {"rev": {"stats": {"field": "price"}}}},
+    "weekly": {"date_histogram": {"field": "ts", "fixed_interval": "7d"},
+               "aggs": {"p": {"sum": {"field": "price"}}}},
+}
+
+
+def _run_aggs(ctx, masks, spec=None):
+    """Full agg pipeline (collect -> reduce -> finalize) per mask."""
+    from elasticsearch_tpu.search.aggregations import (
+        collect_leaf, finalize_aggs, parse_aggs, reduce_partials,
+    )
+
+    aggs, pipes = parse_aggs(spec or AGG_BENCH_SPEC)
+    out = []
+    for m in masks:
+        partial = collect_leaf(aggs, ctx, m)
+        out.append(finalize_aggs(aggs, pipes,
+                                 reduce_partials(aggs, [partial])))
+    return out
+
+
+def dryrun_agg() -> int:
+    """Device-analytics dry-run (PR 18): a Zipf terms + time-bucketed
+    metrics workload on the virtual CPU mesh, asserting (a) device
+    aggregations bit-identical to the host aggregators across query
+    masks (including an empty one), (b) zero retraces once batch rungs
+    are primed, (c) ledger bytes == the engine's own agg-column
+    accounting, and (d) the ES_TPU_AGG=0 A/B serving the same bits with
+    zero device counters. One JSON line on stdout; exit 0/1."""
+    if os.environ.get("TEST_ON_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import elasticsearch_tpu.search.aggregations as agg_mod
+    from elasticsearch_tpu.common import hbm_ledger
+    from elasticsearch_tpu.search import agg_device
+
+    n_docs = 24_000
+    ctx = _synth_agg_leaf(n_docs)
+    rng = np.random.default_rng(5)
+    masks = [rng.random(n_docs) < sel
+             for sel in (0.05, 0.2, 0.5, 0.9, 0.02)]
+    masks.append(np.zeros(n_docs, bool))         # empty-mask edge
+
+    log(f"dryrun_agg: {n_docs} docs, {len(masks)} query masks...")
+    eng = agg_device.default_engine()
+    eng.extend_qc_sizes([1, 4, 16])              # scheduler-ladder priming
+    c0 = dict(agg_device.agg_stats())
+
+    agg_mod.AGG_DEVICE_MIN_DOCS = 1
+    _run_aggs(ctx, masks[:1])                    # warm: layouts + traces
+    r0 = hbm_ledger.compile_stats()["retraces"]
+    dev = _run_aggs(ctx, masks)
+    retraces = hbm_ledger.compile_stats()["retraces"] - r0
+    c1 = dict(agg_device.agg_stats())
+
+    agg_mod.AGG_DEVICE_MIN_DOCS = 1 << 60
+    host = _run_aggs(ctx, masks)
+    agree = float(np.mean([d == h for d, h in zip(dev, host)]))
+
+    ledger_ok = (eng.hbm_bytes() == eng.ledger_bytes()
+                 and eng.hbm_bytes() > 0)
+    dispatches = c1["agg_device_dispatches"] - c0["agg_device_dispatches"]
+    fallbacks = c1["agg_host_fallbacks"] - c0["agg_host_fallbacks"]
+
+    # A/B: knob off serves the same bits through the host path verbatim
+    agg_mod.AGG_DEVICE_MIN_DOCS = 1
+    os.environ["ES_TPU_AGG"] = "0"
+    try:
+        ca = dict(agg_device.agg_stats())
+        off = _run_aggs(ctx, masks)
+        cb = dict(agg_device.agg_stats())
+    finally:
+        del os.environ["ES_TPU_AGG"]
+    ab_ok = (off == host
+             and ca["agg_queries"] == cb["agg_queries"]
+             and ca["agg_device_dispatches"] == cb["agg_device_dispatches"])
+
+    ok = (agree == 1.0 and retraces == 0 and ledger_ok and ab_ok
+          and dispatches >= len(masks) and fallbacks == 0)
+    print(json.dumps({
+        "metric": "dryrun_agg",
+        "ok": bool(ok),
+        "agreement": agree,
+        "retraces": int(retraces),
+        "agg_device_dispatches": int(dispatches),
+        "agg_host_fallbacks": int(fallbacks),
+        "agg_hbm_bytes": int(eng.hbm_bytes()),
+        "ledger_matches_engine": bool(ledger_ok),
+        "ab_host_path_ok": bool(ab_ok),
+    }), flush=True)
+    log(f"dryrun_agg: agreement={agree} retraces={retraces} "
+        f"dispatches={dispatches} ledger_ok={ledger_ok} ab_ok={ab_ok}")
     return 0 if ok else 1
 
 
@@ -2061,6 +2261,9 @@ if __name__ == "__main__":
     if "dryrun_sparse" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_sparse":
         sys.exit(dryrun_sparse())
+    if "dryrun_agg" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_agg":
+        sys.exit(dryrun_agg())
     if "dryrun_disruption" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_disruption":
         sys.exit(dryrun_disruption())

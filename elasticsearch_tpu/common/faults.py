@@ -93,6 +93,7 @@ KNOWN_SITES = frozenset({
     "bitset_intersect",  # packed-uint32 bool match-set pack/intersect
     "sparse_gather",     # eager sparse slice build/upload + gather dispatch
     "blockmax_pass",     # BlockMax engine device pass
+    "agg_reduce",        # device aggregation segment-reduce dispatch
 }) | TRANSPORT_SITES | DURABILITY_SITES | OVERLOAD_SITES | CORRUPTION_SITES
 
 _MODES = frozenset({"raise", "oom", "hang"})

@@ -261,6 +261,18 @@ declare_counter("sched_flushes",
                 "adaptive-scheduler batch flushes (sampler-ring deltas "
                 "give the flush rate)")
 
+# device analytics tier (PR 18), bumped by search/agg_device.py; the
+# same counts back the tpu_agg section of GET /_nodes/stats
+declare_counter("agg_queries",
+                "agg collects served by the device aggregation engine")
+declare_counter("agg_device_dispatches",
+                "fused agg segment-reduce device dispatches")
+declare_counter("agg_host_fallbacks",
+                "agg collects that fell back to the host aggregators "
+                "(unsupported shape, over budget, or device fault)")
+declare_counter("agg_bytes",
+                "precomputed agg-column bytes uploaded to HBM (cumulative)")
+
 
 # --- Prometheus text exposition ----------------------------------------------
 
@@ -435,6 +447,8 @@ declare_histogram("bitset_blocks_skipped", "count", "2048-doc chunks skipped (al
 declare_histogram("bitset_block_occupancy", "ratio", "fraction of 2048-doc chunks with surviving docs after clause intersection, per bool query")
 # eager sparse impact slices for cold terms (PR 17)
 declare_histogram("sparse_slice_width", "count", "padded width (postings) of the ladder rung chosen per eager sparse cold-term slice build")
+# device analytics tier (PR 18)
+declare_histogram("agg_batch_size", "count", "agg collects fused into one device segment-reduce dispatch (pre-padding)")
 declare_histogram("sched_tier_wait.interactive", "ms", "scheduler wait, interactive tier (enqueue -> batch results ready)")
 declare_histogram("sched_tier_wait.bulk", "ms", "scheduler wait, bulk tier (enqueue -> batch results ready)")
 # cluster task plane (PR 11); task_duration.* names are composed

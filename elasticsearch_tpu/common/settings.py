@@ -475,6 +475,16 @@ declare_knob("ES_TPU_INTEGRITY_SCRUB_S", "float", 0.0,
              "device-resident region per tick on the management pool, "
              "re-hash against the host-side fingerprint, re-upload on "
              "mismatch; skipped while the overload level is not GREEN")
+# device analytics tier (PR 18)
+declare_knob("ES_TPU_AGG", "flag", True,
+             "Route terms/histogram/date_histogram collects (and their "
+             "metric sub-aggs) through the device aggregation engine on "
+             "leaves above the size floor; off = the exact host "
+             "aggregators serve everything (A/B reference path)")
+declare_knob("ES_TPU_AGG_HBM_FRAC", "float", 0.25,
+             "Cap on precomputed agg-column HBM as a fraction of "
+             "ES_TPU_TURBO_HBM: layouts that would exceed it are refused "
+             "and their collects stay on host")
 
 
 class ClusterSettings:

@@ -25,6 +25,16 @@ entirely as matmuls and tiled vector ops:
   row-max cascade (one full pass, then NCAND cheap [512]-wide passes) —
   nothing O(n_docs) ever leaves the chip.
 
+* **Segment-reduce for the analytics tier.** Aggregations reduce to the
+  same shape: a segment's (doc, bucket-id) pairs are static, a query is a
+  doc mask, and every bucket count is "sum the mask over my pairs" — a
+  masked segment reduction. `agg_segment_counts` scatters each 1024-pair
+  chunk into a [128, 128] bucket tile with the outer-product trick (bucket
+  = hi*128 + lo within a 16384-bucket tile), batched over the query axis;
+  `agg_two_level_counts` fuses the bucket level and the metric-values
+  level of a sub-aggregation into ONE dispatch. Counts accumulate in f32
+  one-hot matmuls — exact below 2^24 pairs, which agg_device.py gates.
+
 * **Eager sparse impact slices for the cold tier.** Terms too sparse to
   justify a dense column (df below the cold threshold) keep their postings
   as packed ``doc << 8 | impact`` int32 lanes in a granule pool
@@ -61,6 +71,8 @@ MAX_GROUP_ROWS = 144  # posting rows DMA'd per build group (tile spans
 #                       <= 130 rows; padded to a sublane multiple)
 SPARSE_GRAN = 1024    # packed (doc, impact) lanes per slice-pool granule
 SPARSE_IMP_MAX = 255  # uint8 impact quantization ceiling (doc << 8 | imp)
+AGG_PAIR_GRAN = 1024  # (doc, bucket) pairs per agg segment-reduce chunk
+AGG_SEG_TILE = 16384  # bucket ids per [128, 128] accumulator tile
 
 
 def _interpret() -> bool:
@@ -938,3 +950,132 @@ def sparse_pool_update(pool, idx, upd):
     update. Padding rows point at granule 0 with all-zero payloads, so
     the reserved zero granule stays zero."""
     return pool.at[idx].set(upd)
+
+
+# --------------------------------------------------------------------------
+# analytics-tier segment reduce (agg_device.py)
+# --------------------------------------------------------------------------
+
+
+def _agg_count_kernel():
+    def kernel(ct0, ct1, sel_blk, seg_blk, acc_ref):
+        t = pl.program_id(1)
+        c = pl.program_id(2)
+
+        @pl.when(c == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros((1, 1, 128, 128), jnp.float32)
+
+        # pairs are grouped, so the host-prefetched inclusive bucket-tile
+        # range [ct0, ct1] skips every tile a chunk cannot touch (padding
+        # chunks carry the empty range (1, 0) and never scatter)
+        @pl.when((t >= ct0[c]) & (t <= ct1[c]))
+        def _scatter():
+            base = t * AGG_SEG_TILE
+            col = jax.lax.broadcasted_iota(jnp.int32, (128, 128), 1)
+            tacc = jnp.zeros((128, 128), jnp.float32)
+            for r in range(AGG_PAIR_GRAN // 128):
+                seg = seg_blk[0, r, :]                    # [128] i32 bucket
+                val = sel_blk[0, 0, r, :]                 # [128] f32 0/1
+                rel = seg - base
+                ok = (seg >= 0) & (rel >= 0) & (rel < AGG_SEG_TILE)
+                rel = jnp.where(ok, rel, 0)
+                v = jnp.where(ok, val, 0.0)
+                hi = jax.lax.shift_right_logical(rel, 7)[:, None]
+                lo = jnp.bitwise_and(rel, 127)[:, None]
+                A = jnp.where(col == hi, 1.0, 0.0)
+                Bm = jnp.where(col == lo, v[:, None], 0.0)
+                tacc = tacc + jax.lax.dot_general(
+                    A, Bm, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            acc_ref[0, 0, :, :] += tacc
+
+    return kernel
+
+
+def _agg_counts(mask, doc, seg, ct0, ct1, n_segments: int):
+    """One masked segment reduction: counts[q, s] = |{pairs (d, s) with
+    mask[q, d]}| — the scatter-as-outer-product trick applied to bucket
+    ids (within a 16384-bucket tile, bucket = hi*128 + lo). Pre-gathering
+    the mask at the pair docs keeps the kernel scatter-only, the same
+    split as `_segment_count_program` used before this kernel existed."""
+    Q = mask.shape[0]
+    p = doc.shape[0]
+    nc = p // AGG_PAIR_GRAN
+    n_tiles = -(-n_segments // AGG_SEG_TILE)
+    sel = jnp.take(mask, doc, axis=1).astype(jnp.float32)
+    acc = pl.pallas_call(
+        _agg_count_kernel(),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(Q, n_tiles, nc),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, AGG_PAIR_GRAN // 128, 128),
+                    lambda q, t, c, ct0, ct1: (q, c, 0, 0)),
+                pl.BlockSpec(
+                    (1, AGG_PAIR_GRAN // 128, 128),
+                    lambda q, t, c, ct0, ct1: (c, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, 128, 128),
+                lambda q, t, c, ct0, ct1: (q, t, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((Q, n_tiles, 128, 128),
+                                       jnp.float32),
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )(ct0, ct1,
+      sel.reshape(Q, nc, AGG_PAIR_GRAN // 128, 128),
+      seg.reshape(nc, AGG_PAIR_GRAN // 128, 128))
+    flat = acc.reshape(Q, n_tiles * AGG_SEG_TILE)
+    return flat[:, :n_segments].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("p", "n_segments"))
+def agg_segment_counts(mask, blob, *, p: int, n_segments: int):
+    """Batched bucket counting for one agg layout: one device dispatch
+    answers Q queries' doc counts over the layout's static (doc, bucket)
+    pairs. `blob` is the layout's single device-resident i32 column —
+    sections [doc pairs | bucket pairs | ct0 | ct1] — so the HBM ledger
+    and the scrub registry see exactly one region per layout.
+
+    mask [Q, n_docs] bool — one query mask per batched agg work
+    blob [2p + 2(p/1024)] i32 — p 1024-aligned; pad pairs carry doc 0 /
+        bucket -1 (the kernel's ok-gate drops them)
+
+    Returns [Q, n_segments] i32 — exact doc counts per bucket (f32
+    accumulation, exact while p < 2^24 — agg_device.py gates)."""
+    nc = p // AGG_PAIR_GRAN
+    return _agg_counts(mask, blob[:p], blob[p:2 * p],
+                       blob[2 * p:2 * p + nc],
+                       blob[2 * p + nc:2 * p + 2 * nc], n_segments)
+
+
+@functools.partial(jax.jit, static_argnames=("pd", "pm", "n_segments"))
+def agg_two_level_counts(mask, blob, *, pd: int, pm: int, n_segments: int):
+    """Fused two-level reduction for metric-under-bucket sub-aggs: ONE
+    dispatch returns both the bucket doc counts (level 1, over the
+    (doc, bucket) pairs) and the bucket value counts (level 2, over the
+    bucket × metric-value cross pairs) — instead of B per-bucket sweeps.
+    Host-side exact refinement then splits the pre-sorted metric values
+    at the value-count boundaries (agg_device.py), so float metrics keep
+    the host aggregators' exact summation order.
+
+    blob sections: [doc(pd) | seg(pd) | dct0 | dct1 | mdoc(pm) |
+    mseg(pm) | mct0 | mct1], all i32, pair sections 1024-aligned.
+
+    Returns ([Q, n_segments] i32 doc counts, [Q, n_segments] i32 value
+    counts)."""
+    ncd = pd // AGG_PAIR_GRAN
+    ncm = pm // AGG_PAIR_GRAN
+    o = 2 * pd + 2 * ncd
+    dc = _agg_counts(mask, blob[:pd], blob[pd:2 * pd],
+                     blob[2 * pd:2 * pd + ncd],
+                     blob[2 * pd + ncd:o], n_segments)
+    vc = _agg_counts(mask, blob[o:o + pm], blob[o + pm:o + 2 * pm],
+                     blob[o + 2 * pm:o + 2 * pm + ncm],
+                     blob[o + 2 * pm + ncm:o + 2 * pm + 2 * ncm],
+                     n_segments)
+    return dc, vc

@@ -2123,6 +2123,7 @@ class _Handlers:
             "tpu_search_latency": _tpu_search_latency_stats(),
             "tpu_settings": _tpu_settings_stats(),
             "tpu_hbm": _tpu_hbm_stats(),
+            "tpu_agg": _tpu_agg_stats(),
             "tpu_compile": _tpu_compile_stats(),
             "tpu_tasks": self.node.tasks.stats(),
             "tpu_overload": self.node.overload.stats(),
@@ -2617,6 +2618,16 @@ def _overload_admission(node):
                                      str(max(1, int(retry_after)))})
 
     return admission
+
+
+def _tpu_agg_stats() -> dict:
+    """Device analytics section (PR 18): collects served on device,
+    fused dispatches, host fallbacks, and the HBM bytes held by the
+    engine's precomputed agg columns (reconciles with tpu_hbm's `agg`
+    engine entry byte-for-byte)."""
+    from elasticsearch_tpu.search import agg_device
+
+    return agg_device.agg_stats()
 
 
 def _tpu_compile_stats() -> dict:

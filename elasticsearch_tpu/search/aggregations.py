@@ -657,65 +657,13 @@ class TopHitsAgg(Agg):
 AGG_DEVICE_MIN_DOCS = 65536   # below this the dispatch overhead dominates
 
 
-def _terms_device_counts(ctx: AggContext, fname: str, kc,
-                         mask: np.ndarray) -> np.ndarray:
-    """Per-term doc counts on DEVICE: one segment-sum over the segment's
-    static (doc, term-ord) value pairs, sorted by ord (SURVEY §7 step 7 —
-    the terms-agg collect as a device kernel instead of a per-term host
-    loop). The pair layout is built once per (segment, field) and cached
-    with the segment's device arrays; each query then pays one gather +
-    segment_sum, O(values), no [n_docs] mask per term."""
-    import jax
-    import jax.numpy as jnp
-
-    seg = ctx.leaf.segment
-    key = f"aggterms:{fname}"
-    cached = seg._device.get(key)
-    if cached is None:
-        counts = (kc.ord_start[1:] - kc.ord_start[:-1])
-        doc_of_value = np.repeat(np.arange(seg.n_docs, dtype=np.int32),
-                                 counts)
-        order = np.argsort(kc.all_ords, kind="stable")
-        cached = (jnp.asarray(doc_of_value[order]),
-                  jnp.asarray(kc.all_ords[order]),
-                  len(kc.terms))
-        seg._device[key] = cached
-    docs_idx, ord_idx, n_terms = cached
-    # charge the dominant allocations: the per-segment device pair arrays
-    # (resident for the segment's lifetime; charged transiently here since
-    # segments have no release hook) plus the counts output
-    charge = int(docs_idx.size) * 8 + n_terms * 4
-    if ctx.breaker is not None:
-        ctx.breaker.add_estimate_bytes_and_maybe_break(
-            charge, "<terms_agg_device_counts>")
-    try:
-        out = np.asarray(_segment_count_program(
-            jnp.asarray(mask), docs_idx, ord_idx, n_segments=n_terms))
-    finally:
-        if ctx.breaker is not None:
-            ctx.breaker.release(charge)
-    return out
-
-
-def _segment_count_program(mask, doc_idx, seg_ids, *, n_segments):
-    import jax
-    import jax.numpy as jnp
-
-    global _SEG_COUNT_JIT
-    try:
-        fn = _SEG_COUNT_JIT
-    except NameError:
-        from functools import partial as _partial
-
-        @_partial(jax.jit, static_argnames=("n_segments",))
-        def fn(mask, doc_idx, seg_ids, *, n_segments):
-            sel = jnp.take(mask, doc_idx).astype(jnp.int32)
-            return jax.ops.segment_sum(sel, seg_ids,
-                                       num_segments=n_segments,
-                                       indices_are_sorted=True)
-
-        _SEG_COUNT_JIT = fn
-    return fn(mask, doc_idx, seg_ids, n_segments=n_segments)
+def _agg_device():
+    """The device analytics tier (search/agg_device.py): batched fused
+    segment-reduce aggregation, replacing the old per-query
+    `_terms_device_counts` segment_sum seam. Lazy so jax only loads once
+    a leaf is large enough to route."""
+    from elasticsearch_tpu.search import agg_device
+    return agg_device
 
 
 class TermsAgg(BucketAgg):
@@ -725,12 +673,10 @@ class TermsAgg(BucketAgg):
         fname = self.params["field"]
         kc = _keyword_col(ctx, fname)
         out: Dict[Any, dict] = {}
-        if kc is not None and not self.sub and \
-                ctx.leaf.n_docs >= AGG_DEVICE_MIN_DOCS:
-            counts = _terms_device_counts(ctx, fname, kc, mask & kc.exists)
-            nz = np.nonzero(counts)[0]
-            return {kc.terms[o]: {"doc_count": int(counts[o]), "sub": {}}
-                    for o in nz}
+        if kc is not None and ctx.leaf.n_docs >= AGG_DEVICE_MIN_DOCS:
+            dev = _agg_device().collect_terms(self, ctx, kc, mask)
+            if dev is not None:
+                return dev
         if kc is not None:
             sel = mask & kc.exists
             counts = kc.ord_start[1:] - kc.ord_start[:-1]
@@ -822,6 +768,11 @@ class HistogramAgg(BucketAgg):
 
     def collect(self, ctx, mask):
         fname = self.params["field"]
+        col = ctx.leaf.segment.numeric.get(fname)
+        if col is not None and ctx.leaf.n_docs >= AGG_DEVICE_MIN_DOCS:
+            dev = _agg_device().collect_histogram(self, ctx, col, mask)
+            if dev is not None:
+                return dev
         vals, exists = _numeric_first(ctx, fname, mask)
         sel = exists
         # keys round to 10 decimals everywhere (collect, reduce, gap fill) so

@@ -511,11 +511,16 @@ class TurboEngine:
 
     def extend_qc_sizes(self, sizes) -> None:
         """Scheduler bucket-ladder hook: widen every partition's (and the
-        fused dispatcher's) compiled width set."""
+        fused dispatcher's) compiled width set. The device aggregation
+        engine shares the ladder so agg dispatches are primed before the
+        first analytics request ever reaches its lane."""
         for t in self.turbos:
             t.extend_qc_sizes(sizes)
         if self._sharded is not None:
             self._sharded.extend_qc_sizes(sizes)
+        from elasticsearch_tpu.search import agg_device
+
+        agg_device.default_engine().extend_qc_sizes(sizes)
 
     def sparse_hot_terms(self) -> list:
         """Union of the partitions' resident eager-sparse cold-term

@@ -350,8 +350,12 @@ class AdaptiveDispatchScheduler:
             ct.note_dispatch()
         if knob("ES_TPU_COALESCE_US") <= 0 \
                 or len(queries) > self.small_batch_max:
+            # direct dispatches skip the lane but still belong to an SLA
+            # tier — account them so stats()["tiers"] covers ALL traffic
+            tier = tier if tier in _TIERS else current_tier()
             with self._lock:
                 self._direct_dispatches += 1
+                self._tier_counts[tier] = self._tier_counts.get(tier, 0) + 1
             t_dev = time.monotonic()
             out = DispatchCoalescer._run(engine, queries, k, check=check,
                                          fault_log=fault_log)
