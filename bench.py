@@ -80,7 +80,7 @@ def left() -> float:
 
 N_DOCS = int(os.environ.get("BENCH_DOCS", 10_000_000))
 VOCAB = int(os.environ.get("BENCH_VOCAB", 500_000))
-KNN_DOCS = int(os.environ.get("BENCH_KNN_DOCS", 1_000_000))
+KNN_DOCS = int(os.environ.get("BENCH_KNN_DOCS", 10_000_000))
 KNN_DIMS = 768
 QUERIES = 256
 K = 10
@@ -88,6 +88,10 @@ ITERS = int(os.environ.get("BENCH_ITERS", 16))
 LAT_SINGLES = 32
 LAT_BATCHES = 4
 CPU_SAMPLE = int(os.environ.get("BENCH_CPU_SAMPLE", 64))
+# comma-separated leg names to skip (smoke runs targeting one config):
+# throughput, concurrent, config2, config3, config4, config6
+SKIP_LEGS = {s.strip() for s in
+             os.environ.get("BENCH_SKIP", "").split(",") if s.strip()}
 # cold_df tuned for the Zipf corpus: every colizable term's column stays
 # resident (no churn) within the HBM budget; terms below it have <= cold_df
 # postings, which the host scores exactly in microseconds
@@ -417,8 +421,6 @@ def main():
 
     from elasticsearch_tpu.index.segment import VectorColumn
     from elasticsearch_tpu.parallel import make_mesh
-    from elasticsearch_tpu.parallel.spmd import build_stacked_knn, \
-        sharded_knn_topk
     from elasticsearch_tpu.search.serving import select_bm25_engine
 
     detail = RESULT["detail"]
@@ -488,24 +490,29 @@ def main():
     }
     detail["config1_match"] = c1
 
-    log("config1 throughput...")
-    t1batch = time.time()
-    eng.search_many([draw_batch()], k=K)
-    batch_s = time.time() - t1batch
-    # fit the measured loop inside the remaining budget: leave room for the
-    # CPU baseline (+agreement) and the later configs
-    iters = max(2, min(ITERS, int((left() * 0.25) / max(batch_s, 1e-3))))
-    batches = [draw_batch() for _ in range(iters)]
-    t0 = time.time()
-    eng.search_many(batches, k=K)
-    match_qps = QUERIES * iters / (time.time() - t0)
+    if "throughput" not in SKIP_LEGS:
+        log("config1 throughput...")
+        t1batch = time.time()
+        eng.search_many([draw_batch()], k=K)
+        batch_s = time.time() - t1batch
+        # fit the measured loop inside the remaining budget: leave room for
+        # the CPU baseline (+agreement) and the later configs
+        iters = max(2, min(ITERS, int((left() * 0.25) / max(batch_s, 1e-3))))
+        batches = [draw_batch() for _ in range(iters)]
+        t0 = time.time()
+        eng.search_many(batches, k=K)
+        match_qps = QUERIES * iters / (time.time() - t0)
 
-    lat256 = []
-    for _ in range(LAT_BATCHES):
-        b = draw_batch()
-        t1 = time.time()
-        eng.search_many([b], k=K)
-        lat256.append(time.time() - t1)
+        lat256 = []
+        for _ in range(LAT_BATCHES):
+            b = draw_batch()
+            t1 = time.time()
+            eng.search_many([b], k=K)
+            lat256.append(time.time() - t1)
+    else:
+        match_qps = 0.0
+        iters = 0
+        lat256 = [0.0]
 
     log("config1 cpu baseline + agreement...")
     sample = draw_batch()
@@ -553,7 +560,7 @@ def main():
     # batching at all (window 0) — reporting per-tier p50/p95 and the
     # device pad-ratio each path paid. Rows must stay bit-identical to the
     # window-0 leg.
-    if left() > 240:
+    if left() > 240 and "concurrent" not in SKIP_LEGS:
         from elasticsearch_tpu.common import metrics as _metrics
         from elasticsearch_tpu.threadpool.coalescer import DispatchCoalescer
         from elasticsearch_tpu.threadpool.scheduler import (
@@ -676,73 +683,160 @@ def main():
             "sweep": sweep,
         }
 
-    # ================= config 4: knn (cheap; before the host-heavy ones) ==
-    if left() > 180:
+    # ========== config 4: quantized knn (PR 19: int8 shards + rescore) ====
+    if left() > 180 and "config4" not in SKIP_LEGS:
         try:
-            log("config4 knn build...")
+            from elasticsearch_tpu.parallel.knn import KnnEngine, KnnWork
+
+            log("config4 knn build (quantized shards)...")
             t0 = time.time()
             krng = np.random.default_rng(7)
-            vecs = krng.standard_normal((KNN_DOCS, KNN_DIMS), dtype=np.float32)
-            vc = VectorColumn(vectors=vecs,
-                              norms=np.linalg.norm(vecs, axis=1).astype(np.float32),
-                              exists=np.ones(KNN_DOCS, bool), dims=KNN_DIMS,
-                              similarity="cosine")
-            kseg = _Seg(KNN_DOCS, vectors={"emb": vc})
-            kst = build_stacked_knn([kseg], "emb", mesh=mesh)
+            kdev = max(1, len(jax.devices()))
+            kmesh = make_mesh(kdev, dp=1) if kdev > 1 else None
+            part_n = -(-KNN_DOCS // max(kdev, 1))
+            kcols = []
+            for s in range(max(kdev, 1)):
+                n_i = min(part_n, KNN_DOCS - s * part_n)
+                if n_i <= 0:
+                    break
+                pv = krng.standard_normal(
+                    (n_i, KNN_DIMS), dtype=np.float32)
+                kcols.append(VectorColumn(
+                    vectors=pv,
+                    norms=np.linalg.norm(pv, axis=1).astype(np.float32),
+                    exists=np.ones(n_i, bool), dims=KNN_DIMS,
+                    similarity="cosine"))
+            keng = KnnEngine(kcols, mesh=kmesh)
             kbuild = round(time.time() - t0, 1)
             kq = krng.standard_normal((QUERIES, KNN_DIMS)).astype(np.float32)
-            sharded_knn_topk(mesh, kst, kq, k=K)   # warmup at timed shape
-            t0 = time.time()
-            k_s, _, k_o = sharded_knn_topk(mesh, kst, kq, k=K)
-            knn_wall = time.time() - t0
+            kworks = [KnnWork(q) for q in kq]
+            keng.extend_qc_sizes([QUERIES, QUERIES // 2])
 
-            def cpu_knn(q):
-                dots = vecs @ q                          # f32 BLAS
+            os.environ["ES_TPU_KNN_INT8"] = "1"
+            keng.search_many([kworks], k=K)        # warmup at timed shape
+            t0 = time.time()
+            q_s, q_p, q_o = keng.search_many([kworks], k=K)[0]
+            int8_wall = time.time() - t0
+            os.environ["ES_TPU_KNN_INT8"] = "0"    # f32 brute-force A/B
+            keng.search_many([kworks], k=K)
+            t0 = time.time()
+            f_s, f_p, f_o = keng.search_many([kworks], k=K)[0]
+            f32_wall = time.time() - t0
+            os.environ["ES_TPU_KNN_INT8"] = "1"
+            routes_identical = (np.array_equal(q_s, f_s)
+                                and np.array_equal(q_p, f_p)
+                                and np.array_equal(q_o, f_o))
+
+            # exact f32 CPU reference on a sample (recall ground truth),
+            # rows pre-normalized once — the upload-time convention
+            def cpu_knn(col, q):
+                vn = col.vectors / np.maximum(
+                    col.norms, 1e-20)[:, None]               # f32 BLAS
+                dots = vn @ q
                 qn = np.float32(np.linalg.norm(q))
-                sc = (1.0 + dots / np.maximum(qn * vc.norms, 1e-20)) / 2.0
+                sc = (1.0 + dots / max(qn, np.float32(1e-20))) / 2.0
                 sel = np.argpartition(-sc, K)[:K]
                 sel = sel[np.lexsort((sel, -sc[sel]))]
                 return sel.astype(np.int64), sc[sel].astype(np.float32)
 
+            n_cpu = min(CPU_SAMPLE, QUERIES)
             t0 = time.time()
-            cpu_kres = [cpu_knn(q) for q in kq[:16]]
-            cpu_knn_qps = 16 / (time.time() - t0)
-            cpu_kres += [cpu_knn(q) for q in kq[16:]]
             overlap = 0
-            for qi in range(QUERIES):
-                overlap += len(set(k_o[qi].astype(int))
-                               & set(cpu_kres[qi][0].astype(int)))
+            for qi in range(n_cpu):
+                truth = set()
+                rows = []
+                for pi, col in enumerate(kcols):
+                    sel, sc = cpu_knn(col, kq[qi])
+                    rows += [(s, pi, o) for s, o in zip(sc, sel)]
+                rows.sort(key=lambda r: (-r[0], r[1], r[2]))
+                truth = {(p, int(o)) for _, p, o in rows[:K]}
+                got = {(int(q_p[qi, j]), int(q_o[qi, j]))
+                       for j in range(K) if q_s[qi, j] > 0}
+                overlap += len(truth & got)
+            cpu_knn_qps = n_cpu / (time.time() - t0)
+            st = keng.stats()
             detail["config4_knn"] = {
-                "qps": round(QUERIES / knn_wall, 1),
+                "qps": round(QUERIES / int8_wall, 1),
+                "f32_qps": round(QUERIES / f32_wall, 1),
+                "int8_vs_f32": round(f32_wall / int8_wall, 2),
                 "cpu_qps": round(cpu_knn_qps, 1),
-                "vs_cpu": round(QUERIES / knn_wall / cpu_knn_qps, 2),
-                "recall_at_10": round(overlap / (QUERIES * K), 4),
-                "n_vectors": KNN_DOCS, "dims": KNN_DIMS, "build_s": kbuild,
-                "note": "device scores bf16 matmul (f32 accumulate); "
-                        "recall vs exact f32 CPU",
+                "vs_cpu": round(QUERIES / int8_wall / cpu_knn_qps, 2),
+                "routes_identical": bool(routes_identical),
+                "recall_at_10": round(overlap / (n_cpu * K), 4),
+                "n_vectors": KNN_DOCS, "dims": KNN_DIMS,
+                "partitions": len(kcols), "build_s": kbuild,
+                "hbm_bytes": int(keng.hbm_bytes()),
+                "int8_bytes_per_vector": round(
+                    keng.d_q8.nbytes / max(KNN_DOCS, 1), 1),
+                "note": "int8 first pass + exact f32 rescore, bit-equal "
+                        "to the f32 brute-force route; recall vs exact "
+                        "f32 CPU",
             }
 
-            # ============= config 5: hybrid msearch =============
+            # ===== config 5: hybrid (filtered kNN, fused vs 2-dispatch) ====
+            # the synthetic vector space is doc-aligned with the BM25
+            # index when KNN_DOCS == N_DOCS, so a match query's candidate
+            # mask (postings union) IS a kNN filter over the same docs
             half = QUERIES // 2
             log("config5 hybrid...")
             m_batch = draw_batch(half)
             h_kq = kq[:half]
+            spans = [0] + [len(c.vectors) for c in kcols]
+            spans = np.cumsum(spans)
+
+            def line_filters(terms):
+                mask = np.zeros(KNN_DOCS, bool)
+                for t in terms:
+                    o = fp.term_to_ord.get(t)
+                    if o is not None:
+                        docs = fp.post_doc[int(fp.post_start[o]):
+                                           int(fp.post_start[o + 1])]
+                        mask[docs[docs < KNN_DOCS]] = True
+                return [mask[spans[i]:spans[i + 1]]
+                        for i in range(len(kcols))]
+
+            fused_works = [KnnWork(h_kq[i], filters=line_filters(m_batch[i]))
+                           for i in range(half)]
             eng.search_many([m_batch], k=K)        # warm half-batch shapes
-            sharded_knn_topk(mesh, kst, h_kq, k=K)
+            keng.search_many([[KnnWork(q) for q in h_kq]], k=K)
+            keng.search_many([fused_works], k=K)
+            # two-dispatch reference: the match line on the BM25 engine
+            # plus an unfiltered kNN line — today's hybrid msearch shape
             t0 = time.time()
             eng.search_many([m_batch], k=K)
-            sharded_knn_topk(mesh, kst, h_kq, k=K)
-            hybrid_wall = time.time() - t0
+            keng.search_many([[KnnWork(q) for q in h_kq]], k=K)
+            two_wall = time.time() - t0
+            # fused: filter + kNN in ONE quantized dispatch per chunk
+            t0 = time.time()
+            fu_s, fu_p, fu_o = keng.search_many([fused_works], k=K)[0]
+            fused_wall = time.time() - t0
+            # agreement: the fused filtered line vs the f32 route with the
+            # same masks (both exact, must be bit-identical)
+            os.environ["ES_TPU_KNN_INT8"] = "0"
+            rf_s, rf_p, rf_o = keng.search_many([fused_works], k=K)[0]
+            os.environ["ES_TPU_KNN_INT8"] = "1"
+            fused_identical = (np.array_equal(fu_s, rf_s)
+                               and np.array_equal(fu_p, rf_p)
+                               and np.array_equal(fu_o, rf_o))
             cpu_hybrid_qps = 2.0 / (1.0 / cpu_match_qps + 1.0 / cpu_knn_qps)
             detail["config5_hybrid"] = {
-                "qps": round(QUERIES / hybrid_wall, 1),
+                "qps": round(QUERIES / (two_wall + fused_wall), 1),
+                "fused_qps": round(half / fused_wall, 1),
+                "two_dispatch_qps": round(QUERIES / two_wall, 1),
+                "fused_vs_two_dispatch": round(
+                    (two_wall / 2.0) / fused_wall, 2),
+                "fused_identical_to_f32": bool(fused_identical),
                 "cpu_qps": round(cpu_hybrid_qps, 1),
-                "vs_cpu": round(QUERIES / hybrid_wall / cpu_hybrid_qps, 2),
                 "mix": f"{half} match + {half} knn",
+                "note": "fused = match candidate mask + kNN in one "
+                        "dispatch; two-dispatch = match line + "
+                        "unfiltered kNN line separately",
             }
-            del vecs, kst
+            del kcols, keng
         except Exception as e:   # noqa: BLE001 — a config must not kill the run
-            detail["config4_knn"] = {"error": repr(e)[:300]}
+            key = ("config5_hybrid" if "config4_knn" in detail
+                   else "config4_knn")
+            detail[key] = {"error": repr(e)[:300]}
     else:
         detail["config4_knn"] = {"skipped": "budget"}
 
@@ -763,7 +857,7 @@ def main():
             bmx = BlockMaxBM25(stacked, mesh)
         return bmx
 
-    if left() > 240:
+    if left() > 240 and "config2" not in SKIP_LEGS:
         try:
             bmx2 = eng if eng.kind == "turbo" else blockmax_engine()
             log(f"config2 bool ({bmx2.kind} executor)...")
@@ -831,7 +925,7 @@ def main():
         detail["config2_bool"] = {"skipped": "budget"}
 
     # ================= config 3: phrase =================
-    if left() > 180:
+    if left() > 180 and "config3" not in SKIP_LEGS:
         try:
             log("config3 phrase...")
 
@@ -895,7 +989,7 @@ def main():
         detail["config3_phrase"] = {"skipped": "budget"}
 
     # ================= config 6: analytics (device agg tier) ==========
-    if left() > 120:
+    if left() > 120 and "config6" not in SKIP_LEGS:
         try:
             from elasticsearch_tpu.search import agg_device
             import elasticsearch_tpu.search.aggregations as agg_mod
@@ -1223,6 +1317,112 @@ def dryrun_sparse() -> int:
     }), flush=True)
     log(f"dryrun_sparse: identical={identical} cold_q={cold_q} "
         f"sparse_q={sparse_q} retraces={retraces} ab_ok={ab_ok}")
+    return 0 if ok else 1
+
+
+def dryrun_knn() -> int:
+    """Quantized-kNN dry-run (PR 19): 3-partition fused KnnEngine on the
+    virtual CPU mesh, asserting (a) int8-route top-10 BIT-IDENTITY with
+    the f32 brute-force reference (ops.knn.knn_top_k per partition + the
+    deterministic merge), (b) zero retraces once shapes are primed via
+    extend_qc_sizes, (c) ledger == engine HBM bytes, and (d) the
+    ES_TPU_KNN_INT8=0 A/B reproducing the same bits through the dense
+    route with zero int8 dispatches. One JSON line on stdout; exit 0/1."""
+    if os.environ.get("TEST_ON_TPU") != "1":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    os.environ["ES_TPU_KNN_INT8"] = "1"
+    os.environ.pop("ES_TPU_KNN_NPROBE", None)
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.common import hbm_ledger
+    from elasticsearch_tpu.index.segment import VectorColumn
+    from elasticsearch_tpu.ops.knn import knn_top_k
+    from elasticsearch_tpu.parallel import knn as knn_mod
+    from elasticsearch_tpu.parallel.knn import KnnEngine, KnnWork
+    from elasticsearch_tpu.parallel.spmd import make_mesh
+
+    log("dryrun_knn: building 3-partition fused engine...")
+    rng = np.random.default_rng(11)
+    dims = 64
+    cols = []
+    for n in (5000, 3000, 4200):
+        v = rng.standard_normal((n, dims)).astype(np.float32)
+        cols.append(VectorColumn(
+            vectors=v, norms=np.linalg.norm(v, axis=1).astype(np.float32),
+            exists=rng.random(n) > 0.05, dims=dims, similarity="cosine"))
+    eng = KnnEngine(cols, mesh=make_mesh(4, dp=1))
+    nq, k = 24, 10
+    kq = rng.standard_normal((nq, dims)).astype(np.float32)
+    works = [KnnWork(q) for q in kq]
+    eng.extend_qc_sizes([32])
+    eng.search_many([works], k=k)          # warm pass (first trace)
+    r0 = hbm_ledger.compile_stats()["retraces"]
+    knn_mod.reset_for_tests()
+    s, p, o = eng.search_many([works], k=k)[0]
+    retraces = hbm_ledger.compile_stats()["retraces"] - r0
+    st = knn_mod.knn_node_stats()
+
+    # f32 brute-force reference: knn_top_k per partition + the
+    # deterministic (score desc, partition asc, ord asc) merge
+    per = []
+    for col in cols:
+        vn = col.vectors / np.maximum(col.norms, 1e-20)[:, None]
+        ts, to, ok = knn_top_k(
+            jnp.asarray(kq), jnp.asarray(vn).astype(jnp.bfloat16),
+            jnp.asarray(col.norms), jnp.asarray(col.exists),
+            jnp.asarray(np.ones(len(vn), bool)), similarity="cosine", k=k)
+        ts, to, ok = (np.asarray(x) for x in (ts, to, ok))
+        per.append((np.where(ok, ts, 0.0), np.where(ok, to, 0)))
+    ws = np.zeros((nq, k), np.float32)
+    wp = np.zeros((nq, k), np.int32)
+    wo = np.zeros((nq, k), np.int32)
+    for qi in range(nq):
+        rows = [(rs[qi, j], pi, ro[qi, j])
+                for pi, (rs, ro) in enumerate(per)
+                for j in range(k) if rs[qi, j] > 0]
+        rows.sort(key=lambda r: (-r[0], r[1], r[2]))
+        for j, (sv, pv, ov) in enumerate(rows[:k]):
+            ws[qi, j], wp[qi, j], wo[qi, j] = sv, pv, ov
+    identical = (np.array_equal(s, ws) and np.array_equal(p, wp)
+                 and np.array_equal(o, wo))
+    ledger_ok = eng._hbm.total_bytes() == eng.hbm_bytes()
+
+    # A/B: the dense f32 route must serve the same bits, int8 fully off
+    os.environ["ES_TPU_KNN_INT8"] = "0"
+    try:
+        knn_mod.reset_for_tests()
+        s2, p2, o2 = eng.search_many([works], k=k)[0]
+        ab_st = knn_mod.knn_node_stats()
+    finally:
+        os.environ["ES_TPU_KNN_INT8"] = "1"
+    ab_identical = (np.array_equal(s2, ws) and np.array_equal(p2, wp)
+                    and np.array_equal(o2, wo))
+    ab_ok = ab_identical and ab_st["knn_int8_dispatches"] == 0
+    ok = (identical and retraces == 0 and ledger_ok and ab_ok
+          and st["knn_int8_dispatches"] > 0
+          and st["knn_host_fallbacks"] == 0)
+    print(json.dumps({
+        "metric": "dryrun_knn",
+        "ok": bool(ok),
+        "top10_agreement": 1.0 if identical else 0.0,
+        "ab_f32_agreement": 1.0 if ab_identical else 0.0,
+        "retraces": int(retraces),
+        "ledger_matches_engine": bool(ledger_ok),
+        "int8_dispatches": int(st["knn_int8_dispatches"]),
+        "rescore_docs": int(st["knn_rescore_docs"]),
+        "uncertified": int(st["knn_uncertified"]),
+        "host_fallbacks": int(st["knn_host_fallbacks"]),
+        "hbm_bytes": int(eng.hbm_bytes()),
+    }), flush=True)
+    log(f"dryrun_knn: identical={identical} ab={ab_identical} "
+        f"retraces={retraces} ledger_ok={ledger_ok}")
     return 0 if ok else 1
 
 
@@ -2264,6 +2464,9 @@ if __name__ == "__main__":
     if "dryrun_agg" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_agg":
         sys.exit(dryrun_agg())
+    if "dryrun_knn" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_knn":
+        sys.exit(dryrun_knn())
     if "dryrun_disruption" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_disruption":
         sys.exit(dryrun_disruption())

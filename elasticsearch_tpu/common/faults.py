@@ -94,6 +94,8 @@ KNOWN_SITES = frozenset({
     "sparse_gather",     # eager sparse slice build/upload + gather dispatch
     "blockmax_pass",     # BlockMax engine device pass
     "agg_reduce",        # device aggregation segment-reduce dispatch
+    "knn_score",         # KnnEngine first-pass candidate dispatch
+    "knn_rescore",       # KnnEngine exact-rescore dispatch
 }) | TRANSPORT_SITES | DURABILITY_SITES | OVERLOAD_SITES | CORRUPTION_SITES
 
 _MODES = frozenset({"raise", "oom", "hang"})

@@ -273,6 +273,23 @@ declare_counter("agg_host_fallbacks",
 declare_counter("agg_bytes",
                 "precomputed agg-column bytes uploaded to HBM (cumulative)")
 
+# quantized kNN tier (PR 19), bumped by parallel/knn.py; the same counts
+# back the tpu_knn section of GET /_nodes/stats
+declare_counter("knn_queries",
+                "kNN queries served by the quantized KnnEngine")
+declare_counter("knn_int8_dispatches",
+                "int8 first-pass device dispatches (Pallas kernel launches)")
+declare_counter("knn_rescore_docs",
+                "candidate rows exact-rescored in f32 (cumulative)")
+declare_counter("knn_host_fallbacks",
+                "(query, partition) results served by the exact host "
+                "fallback after a contained device fault")
+declare_counter("knn_bytes",
+                "quantized kNN shard bytes uploaded to HBM (cumulative)")
+declare_counter("knn_uncertified",
+                "queries whose int8 superset certificate failed and were "
+                "re-served through the exact f32 first pass")
+
 
 # --- Prometheus text exposition ----------------------------------------------
 
@@ -449,6 +466,9 @@ declare_histogram("bitset_block_occupancy", "ratio", "fraction of 2048-doc chunk
 declare_histogram("sparse_slice_width", "count", "padded width (postings) of the ladder rung chosen per eager sparse cold-term slice build")
 # device analytics tier (PR 18)
 declare_histogram("agg_batch_size", "count", "agg collects fused into one device segment-reduce dispatch (pre-padding)")
+
+declare_histogram("knn_candidates_per_query", "count", "first-pass candidates kept per (query, partition) before the exact kNN rescore")
+declare_histogram("knn_nprobe_ratio", "ratio", "fraction of IVF centroids probed per kNN first pass (1.0 = exact/no pruning)")
 declare_histogram("sched_tier_wait.interactive", "ms", "scheduler wait, interactive tier (enqueue -> batch results ready)")
 declare_histogram("sched_tier_wait.bulk", "ms", "scheduler wait, bulk tier (enqueue -> batch results ready)")
 # cluster task plane (PR 11); task_duration.* names are composed

@@ -265,8 +265,13 @@ class Segment:
                 )
             elif kind == "vec":
                 vc = self.vectors[fname]
+                host = vc.vectors.astype(np.float32)
+                if vc.similarity == "cosine":
+                    # pre-normalize rows at upload: the scoring hot loop
+                    # then divides by the query norm only (ops/knn.py)
+                    host = host / np.maximum(vc.norms, 1e-20)[:, None]
                 out = (
-                    jax.device_put(vc.vectors.astype(np.float32)).astype(jnp.bfloat16),
+                    jax.device_put(host).astype(jnp.bfloat16),
                     jax.device_put(vc.norms),
                     jax.device_put(vc.exists),
                 )

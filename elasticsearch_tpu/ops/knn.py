@@ -12,6 +12,12 @@ drop-in comparable:
   cosine:       (1 + cos(q, d)) / 2
   dot_product:  (1 + dot(q, d)) / 2        (vectors assumed unit-normalized)
   l2_norm:      1 / (1 + l2(q, d))
+
+Cosine columns are pre-normalized at upload time (Segment.device('vec:'),
+spmd.build_stacked_knn, KnnEngine all divide rows by their norm once on
+host), so the per-query hot loop divides by the [Q, 1] query norm only —
+the old [Q, n_docs] f32 divide is gone. `norms` still carries the RAW row
+norms: the l2 path needs them (dd = norms^2), and cosine ignores them.
 """
 
 from __future__ import annotations
@@ -25,8 +31,8 @@ import jax.numpy as jnp
 @partial(jax.jit, static_argnames=("similarity",))
 def knn_scores(
     queries: jax.Array,       # [Q, dims] f32
-    vectors: jax.Array,       # [n_docs, dims] bf16/f32
-    norms: jax.Array,         # [n_docs] f32 — precomputed L2 norms (for cosine)
+    vectors: jax.Array,       # [n_docs, dims] bf16/f32 (unit rows for cosine)
+    norms: jax.Array,         # [n_docs] f32 — RAW row L2 norms (l2 path)
     exists: jax.Array,        # [n_docs] bool — docs that have the vector field
     *,
     similarity: str = "cosine",
@@ -40,8 +46,10 @@ def knn_scores(
         preferred_element_type=jnp.float32,
     )  # [Q, n_docs]
     if similarity == "cosine":
+        # rows are unit vectors (upload-time normalization): divide by the
+        # query norm only
         qn = jnp.linalg.norm(queries, axis=-1, keepdims=True)  # [Q, 1]
-        cos = dots / jnp.maximum(qn * norms[None, :], 1e-20)
+        cos = dots / jnp.maximum(qn, 1e-20)
         scores = (1.0 + cos) / 2.0
     elif similarity == "dot_product":
         scores = (1.0 + dots) / 2.0

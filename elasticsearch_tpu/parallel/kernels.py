@@ -1079,3 +1079,143 @@ def agg_two_level_counts(mask, blob, *, pd: int, pm: int, n_segments: int):
                      blob[o + 2 * pm + ncm:o + 2 * pm + 2 * ncm],
                      n_segments)
     return dc, vc
+
+
+# --------------------------------------------------------------------------
+# quantized kNN first-pass kernel (PR 19)
+# --------------------------------------------------------------------------
+
+KNN_W = 2048          # docs per kNN window (candidate granularity)
+KNN_CANDW = 32        # candidates kept per (query, window)
+
+
+def _knn_pass_kernel(similarity: str, masked: bool):
+    def kernel(qi8, qmeta, q8_blk, meta_blk, act_blk, *rest):
+        if masked:
+            fmask_blk, out_s, out_r = rest
+        else:
+            out_s, out_r = rest
+        w = pl.program_id(0)
+        dn = (((1,), (0,)), ((), ()))
+        dot = jax.lax.dot_general(
+            qi8[...], q8_blk[0], dn,
+            preferred_element_type=jnp.int32)              # [QC, KNN_W]
+        meta = meta_blk[...]                               # [4, 1, KNN_W]
+        scale = meta[0, 0][None, :]                        # per-row int8 step
+        row_l1 = meta[1, 0][None, :]                       # dequantized L1
+        nrm = meta[2, 0][None, :]                          # stored-row L2
+        okf = meta[3, 0][None, :]                          # exists & live
+        qm = qmeta[...]                                    # [QC, 8]
+        sq = qm[:, 0:1]
+        est = dot.astype(jnp.float32) * (scale * sq)
+        # certified optimism: |true_dot - est| <= halfsq*row_l1
+        # + (0.5*ql1 + dims*sq/4)*scale (quantization) plus 2^-7*|q||v|
+        # covering the reference's bf16 cast + f32 accumulation; the 1.05
+        # inflation covers f32 rounding of the slack arithmetic itself
+        slack = (qm[:, 5:6] * row_l1 + qm[:, 1:2] * scale
+                 + 0.0079 * qm[:, 2:3] * nrm)
+        dot_best = est + slack * 1.05 + 1e-6
+        if similarity == "cosine":
+            opt = (1.0 + dot_best * qm[:, 4:5]) * 0.5
+        elif similarity == "dot_product":
+            opt = (1.0 + dot_best) * 0.5
+        else:   # l2_norm: larger dot -> smaller distance -> larger score
+            d2 = jnp.maximum(qm[:, 3:4] + nrm * nrm - 2.0 * dot_best, 0.0)
+            opt = 1.0 / (1.0 + jnp.sqrt(d2))
+        ok = (okf > 0) & (act_blk[...] > 0)
+        if masked:
+            ok = ok & (fmask_blk[:, 0, :] > 0)
+        opt = jnp.where(ok, opt, -jnp.inf)
+        QC = opt.shape[0]
+        cols = jax.lax.broadcasted_iota(jnp.int32, (QC, KNN_W), 1)
+        cand_iota = jax.lax.broadcasted_iota(
+            jnp.int32, (QC, KNN_CANDW), 1)
+        big = jnp.int32(1 << 30)
+        acc_s = jnp.full((QC, KNN_CANDW), -jnp.inf, jnp.float32)
+        acc_r = jnp.zeros((QC, KNN_CANDW), jnp.int32)
+        # KNN_CANDW-pass max cascade (the _toprows idiom — XLA sort runs
+        # at scalar speed on this TPU), tie-break (opt desc, row asc)
+        for p in range(KNN_CANDW):
+            m = jnp.max(opt, axis=1, keepdims=True)        # [QC, 1]
+            at = opt == m
+            rmin = jnp.min(jnp.where(at, cols, big), axis=1, keepdims=True)
+            keep = (cand_iota == p) & (m > -jnp.inf)
+            acc_s = jnp.where(keep, m, acc_s)
+            acc_r = jnp.where(keep, rmin + w * KNN_W, acc_r)
+            opt = jnp.where(cols == rmin, -jnp.inf, opt)
+        out_s[0, :, :] = acc_s
+        out_r[0, :, :] = acc_r
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("similarity",))
+def knn_int8_window_topc(qi8, qmeta, q8, meta, act, fmask=None, *,
+                         similarity: str = "cosine"):
+    """kNN first pass over one partition's int8-quantized shard: per
+    2048-doc window, compute every doc's OPTIMISTIC score (int8 MXU dot
+    descaled + the tracked quantization bound, pushed through the
+    similarity transform — all three transforms are monotone increasing
+    in the dot, so per-doc optimism survives them) and keep the window's
+    top-KNN_CANDW candidates. The union over windows is a provable
+    superset of the true top-k whenever the exact k-th rescore score
+    beats the engine's exclusion bound (parallel/knn.py certificate).
+
+    qi8   [QC, dimsP] i8 — quantized queries (dims zero-padded to 128x)
+    qmeta [QC, 8] f32 — slots: 0 sq (query int8 step), 1 the scale
+          coefficient 0.5*ql1 + dims*sq/4, 2 |q|_2, 3 |q|_2^2,
+          4 1/max(|q|_2, 1e-20), 5 sq/2; rest zero
+    q8    [nw, dimsP, KNN_W] i8 — window-major stored rows (transposed:
+          dims on sublanes, docs on lanes — the MXU contraction layout)
+    meta  [4, nw, KNN_W] f32 — rows (scale, row_l1, nrm, okf); dead pad
+          docs carry okf 0 and never surface
+    act   [QC, nw] f32 — per-query window activity (IVF probe; all-ones
+          when nprobe = 0)
+    fmask [QC, nw, KNN_W] i8 or None — per-query doc filter in STORED
+          row order (serving candidate masks / live deletes)
+
+    Returns (scores [nw, QC, KNN_CANDW] f32, rows [nw, QC, KNN_CANDW]
+    i32) — rows are global stored-row ids (w * KNN_W + lane); empty
+    slots are (-inf, 0).
+    """
+    QC, dimsP = qi8.shape
+    nw = q8.shape[0]
+    kernel = _knn_pass_kernel(similarity, fmask is not None)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.VMEM),             # qi8
+        pl.BlockSpec(memory_space=pltpu.VMEM),             # qmeta
+        pl.BlockSpec((1, dimsP, KNN_W), lambda w: (w, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((4, 1, KNN_W), lambda w: (0, w, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((QC, 1), lambda w: (0, w),
+                     memory_space=pltpu.VMEM),             # act column
+    ]
+    args = [qi8, qmeta, q8, meta, act]
+    if fmask is not None:
+        in_specs.append(pl.BlockSpec((QC, 1, KNN_W), lambda w: (0, w, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(fmask)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(nw,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, QC, KNN_CANDW), lambda w: (w, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, QC, KNN_CANDW), lambda w: (w, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((nw, QC, KNN_CANDW), jnp.float32),
+            jax.ShapeDtypeStruct((nw, QC, KNN_CANDW), jnp.int32),
+        ],
+        compiler_params=_CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=_interpret(),
+    )
+    return fn(*args)

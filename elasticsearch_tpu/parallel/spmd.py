@@ -222,7 +222,12 @@ def build_stacked_knn(
             norms.append(np.zeros(max(seg.n_docs, 1), np.float32))
             exists.append(np.zeros(max(seg.n_docs, 1), bool))
         else:
-            vecs.append(vc.vectors)
+            v = vc.vectors.astype(np.float32)
+            if sim == "cosine":
+                # upload-time row normalization (ops/knn.py convention):
+                # cosine scoring divides by the query norm only
+                v = v / np.maximum(vc.norms, 1e-20)[:, None]
+            vecs.append(v)
             norms.append(vc.norms)
             exists.append(vc.exists)
     if live_masks is None:
@@ -589,8 +594,9 @@ def _knn_program(vectors_a, norms_a, exists_a, live_a, queries_a, *, mesh, k, si
                 q.astype(jnp.bfloat16), v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)        # [Qd, D]
             if similarity == "cosine":
+                # rows are pre-normalized at upload (build_stacked_knn)
                 qn = jnp.linalg.norm(q, axis=-1, keepdims=True)
-                sc = (1.0 + dots / jnp.maximum(qn * nrm[None, :], 1e-20)) / 2.0
+                sc = (1.0 + dots / jnp.maximum(qn, 1e-20)) / 2.0
             elif similarity == "dot_product":
                 sc = (1.0 + dots) / 2.0
             else:  # l2_norm

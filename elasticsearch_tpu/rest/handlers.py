@@ -2124,6 +2124,7 @@ class _Handlers:
             "tpu_settings": _tpu_settings_stats(),
             "tpu_hbm": _tpu_hbm_stats(),
             "tpu_agg": _tpu_agg_stats(),
+            "tpu_knn": _tpu_knn_stats(),
             "tpu_compile": _tpu_compile_stats(),
             "tpu_tasks": self.node.tasks.stats(),
             "tpu_overload": self.node.overload.stats(),
@@ -2628,6 +2629,16 @@ def _tpu_agg_stats() -> dict:
     from elasticsearch_tpu.search import agg_device
 
     return agg_device.agg_stats()
+
+
+def _tpu_knn_stats() -> dict:
+    """Quantized kNN section (PR 19): queries, int8 first-pass
+    dispatches, rescored candidates, certificate misses, host fallbacks,
+    and the HBM bytes held by the quantized shards + centroids
+    (reconciles with tpu_hbm's `knn` engine entry byte-for-byte)."""
+    from elasticsearch_tpu.parallel import knn
+
+    return knn.knn_node_stats()
 
 
 def _tpu_compile_stats() -> dict:

@@ -69,6 +69,10 @@ _ALLOWED_KEYS = {"query", "size", "from", "_source", "stored_fields",
                  "track_total_hits", "version", "seq_no_primary_term",
                  "timeout", "allow_partial_search_results", "profile"}
 _MAX_K = 1000
+# kNN-only bodies: the same envelope plus the top-level `knn` section and
+# minus `query` (a body with BOTH stays on the dense executor, which owns
+# the combined bm25+vector scoring semantics)
+_KNN_ALLOWED_KEYS = (_ALLOWED_KEYS | {"knn"}) - {"query"}
 
 # serving-path fault/containment counters (GET /_nodes/stats tpu_health)
 _SERVING_STATS = {"fastpath_reject_error": 0, "fastpath_device_fault": 0,
@@ -140,6 +144,96 @@ class FlatPlan:
 
 class _Reject(Exception):
     pass
+
+
+@dataclass
+class KnnPlan:
+    """An eligible top-level `knn` body flattened for KnnEngine serving:
+    the query vector plus an optional filter already reduced to postings
+    operations (the SAME FlatPlan machinery the BM25 sweep uses — the
+    filter's candidate mask IS the kNN filter, resolved host-side and
+    shipped into the one fused kNN dispatch)."""
+
+    field: str
+    vector: list
+    k: int
+    filter_plan: Optional[FlatPlan] = None
+
+
+def extract_knn_plan(request: dict, mapper) -> Optional[KnnPlan]:
+    """Flatten an eligible kNN-only request body (top-level `knn`, no
+    `query`) into a KnnPlan, or None for the dense executor. The filter
+    clause must reduce to postings operations (term/terms/match in filter
+    context); scored clauses, boosts != 1 and multi-kNN stay dense."""
+    if any(k not in _KNN_ALLOWED_KEYS for k in request):
+        return None
+    spec = request.get("knn")
+    if spec is None or request.get("query") is not None:
+        return None
+    if isinstance(spec, list):
+        if len(spec) != 1:
+            return None
+        spec = spec[0]
+    if not isinstance(spec, dict):
+        return None
+    size = int(request.get("size", 10))
+    from_ = int(request.get("from", 0))
+    if size <= 0 or from_ + size > _MAX_K:
+        return None
+    if float(spec.get("boost", 1.0)) != 1.0:
+        return None
+    field = spec.get("field")
+    vec = spec.get("query_vector")
+    if not field or vec is None:
+        return None
+    ft = mapper.field_type(field)
+    if ft is None or ft.family != "vector":
+        return None
+    # the knn section's k caps the hit count (size only windows into it),
+    # matching the dense executor's top-level-knn semantics
+    k = int(spec.get("k", 10))
+    if k <= 0 or k > _MAX_K:
+        return None
+    fplan = None
+    if spec.get("filter") is not None:
+        try:
+            node = parse_query(spec["filter"])
+            fplan = FlatPlan()
+            _flatten(node, fplan, mapper, ctx="filter", weight=1.0)
+        except _Reject:
+            return None
+        except Exception as e:
+            _note_reject_error(e, "extract_knn_plan")
+            return None
+        if fplan.disj or fplan.conj or fplan.should or fplan.phrases:
+            return None          # scored clauses inside filter: dense
+        if not fplan.filters and not fplan.must_not:
+            return None
+    return KnnPlan(field=field, vector=vec, k=k, filter_plan=fplan)
+
+
+def _knn_filter_mask(fplan: FlatPlan, part) -> np.ndarray:
+    """One partition's filter candidate mask: AND of per-clause postings
+    unions, minus must_not postings — the BM25 sweep's candidate set for
+    the same clauses, reused verbatim as the kNN doc filter."""
+    seg = part.segment
+    n = seg.n_docs
+    mask = np.ones(n, bool)
+    for f, terms in fplan.filters:
+        fpf = seg.postings.get(f)
+        if fpf is None:
+            return np.zeros(n, bool)
+        m = np.zeros(n, bool)
+        for t in terms:
+            m[_post_docs(fpf, t)] = True
+        mask &= m
+    for f, terms in fplan.must_not:
+        fpf = seg.postings.get(f)
+        if fpf is None:
+            continue
+        for t in terms:
+            mask[_post_docs(fpf, t)] = False
+    return mask
 
 
 def extract_plan(request: dict, mapper) -> Optional[FlatPlan]:
@@ -868,6 +962,7 @@ class ServingSnapshot:
                 base += v.segment.n_docs
         self.total_docs = sum(int(p.live.sum()) for p in self.partitions)
         self._bm: Dict[str, object] = {}
+        self._knn: Dict[str, object] = {}
         self._stats: Dict[str, tuple] = {}
         self._lock = threading.Lock()
 
@@ -915,6 +1010,43 @@ class ServingSnapshot:
                     [p.segment for p in self.partitions], field,
                     [p.live for p in self.partitions], self.mesh)
             return self._bm[field]
+
+    def knn_engine(self, field: str):
+        """The quantized KnnEngine for this snapshot's vector field —
+        built once per (snapshot, field), None when ineligible (no TPU
+        backend and ES_TPU_FORCE_KNN unset, or no partition holds the
+        field). Partitions without the field get an all-missing stub
+        column so engine partition indices stay aligned with
+        snap.partitions."""
+        with self._lock:
+            if field not in self._knn:
+                self._knn[field] = self._build_knn_engine(field)
+            return self._knn[field]
+
+    def _build_knn_engine(self, field: str):  # tpulint: holds=self._lock
+        import jax
+
+        if not knob("ES_TPU_FORCE_KNN") and jax.default_backend() != "tpu":
+            return None
+        from elasticsearch_tpu.index.segment import VectorColumn
+        from elasticsearch_tpu.parallel.knn import KnnEngine
+
+        cols = [p.segment.vectors.get(field) for p in self.partitions]
+        present = [c for c in cols if c is not None]
+        if not present:
+            return None
+        dims = present[0].dims
+        sim = present[0].similarity
+        if any(c.dims != dims or c.similarity != sim for c in present):
+            return None
+        for i, c in enumerate(cols):
+            if c is None:
+                n = self.partitions[i].segment.n_docs
+                cols[i] = VectorColumn(
+                    np.zeros((n, dims), np.float32), np.zeros(n, np.float32),
+                    np.zeros(n, bool), dims, sim)
+        return KnnEngine(cols, lives=[p.live for p in self.partitions],
+                         mesh=self.mesh if len(cols) > 1 else None)
 
 
 # --------------------------------------------------------------------------
@@ -1113,12 +1245,32 @@ class ServingContext:
         if len(self.svc.shards) > 1 and search_type != "dfs_query_then_fetch":
             return [None] * len(requests)
         plans = [extract_plan(r, self.svc.mapper) for r in requests]
-        if not any(plans):
+        kplans = [extract_knn_plan(r, self.svc.mapper) if p is None else None
+                  for p, r in zip(plans, requests)]
+        if not any(plans) and not any(kplans):
             return [None] * len(plans)
         snap = self.snapshot()
         if snap.total_docs == 0:
             return [None] * len(plans)
         out: List[Optional[dict]] = [None] * len(plans)
+
+        # kNN-only bodies on the same vector field batch into ONE fused
+        # quantized dispatch (first pass + rescore), filters included
+        knn_by_field: Dict[str, List[int]] = {}
+        for i, kp in enumerate(kplans):
+            if kp is not None:
+                knn_by_field.setdefault(kp.field, []).append(i)
+        for field, idxs in knn_by_field.items():
+            try:
+                results = self._knn_batch(
+                    field, [kplans[i] for i in idxs],
+                    [requests[i] for i in idxs], snap, task=task)
+                for i, r in zip(idxs, results):
+                    out[i] = r
+            except TaskCancelledError:
+                raise
+            except Exception as e:
+                _note_reject_error(e, "knn_batch")
 
         # group disjunctive plans by field for batched device dispatch
         by_field: Dict[str, List[int]] = {}
@@ -1378,6 +1530,80 @@ class ServingContext:
                     timed_out=bool(d is not None and d.expired),
                     faults=flog,
                     profile_nodes=fastpath_profile_nodes(request, bm, dev_ms)
+                    if request.get("profile") else None))
+            except SearchPhaseExecutionError as e:
+                results.append(e)
+        return results
+
+    def _knn_batch(self, field: str, kplans, requests, snap, task=None):
+        """kNN-only bodies on one vector field: resolve each filter to
+        per-partition candidate masks (postings unions — the BM25 sweep's
+        candidate set) and serve filter + kNN in ONE quantized dispatch
+        per chunk. None per body where the dense executor must run."""
+        from elasticsearch_tpu.parallel.knn import KnnWork
+
+        start = time.monotonic()
+        eng = snap.knn_engine(field)
+        if eng is None:
+            return [None] * len(requests)
+        k = max(kp.k for kp in kplans)
+        works = []
+        for kp in kplans:
+            filters = None
+            if kp.filter_plan is not None:
+                filters = [_knn_filter_mask(kp.filter_plan, p)
+                           for p in snap.partitions]
+            works.append(KnnWork(np.asarray(kp.vector, np.float32),
+                                 filters=filters))
+        deadlines = [self._deadline_for(r) for r in requests]
+        check = self._combined_check(task, deadlines)
+        flog: List[FaultRecord] = []
+        # KnnEngine degrades itself (internal circuit + host-exact tier),
+        # so unlike BlockMax no external circuit enforcement is needed
+        from elasticsearch_tpu.threadpool.scheduler import serving_dispatch
+
+        try:
+            t_dev = time.monotonic()
+            scores, parts, ords = serving_dispatch(
+                eng, works, k, check=check, fault_log=flog)
+            dev_ms = (time.monotonic() - t_dev) * 1e3
+        except DispatchDeadlineError:
+            _count_serving("fastpath_timed_out")
+            return [self._timed_out_response(r, snap, start)
+                    if d is not None and d.timed_out else None
+                    for r, d in zip(requests, deadlines)]
+        except DeviceFaultError as e:
+            eng.health.record_fault(e)
+            _count_serving("fastpath_device_fault")
+            return [None] * len(requests)
+        if flog:
+            _count_serving("shard_fault_recoveries", len(flog))
+        t_demux = time.monotonic()
+        extracted = []
+        for qi, kp in enumerate(kplans):
+            hits = []
+            for j in range(min(k, kp.k)):
+                if scores[qi, j] <= 0 or not np.isfinite(scores[qi, j]):
+                    break
+                hits.append((int(parts[qi, j]), int(ords[qi, j]),
+                             float(scores[qi, j])))
+            # kNN totals are the k nearest by definition, always exact
+            extracted.append((hits, len(hits), "eq"))
+        demux_ms = (time.monotonic() - t_demux) * 1e3
+        metrics.observe("demux", demux_ms)
+        tc = tracing.current()
+        if tc is not None:
+            tc.add_span("demux", demux_ms, batch=len(requests))
+        results = []
+        for qi, request in enumerate(requests):
+            hits, total, relation = extracted[qi]
+            d = deadlines[qi]
+            try:
+                results.append(self._respond(
+                    request, snap, hits, total, relation, start,
+                    timed_out=bool(d is not None and d.expired),
+                    faults=flog,
+                    profile_nodes=fastpath_profile_nodes(request, eng, dev_ms)
                     if request.get("profile") else None))
             except SearchPhaseExecutionError as e:
                 results.append(e)
