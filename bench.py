@@ -1744,6 +1744,97 @@ def dryrun_chaos() -> int:
     return 0 if ok else 1
 
 
+def dryrun_ccs() -> int:
+    """Cross-cluster smoke (PR 20): two 2-node clusters joined by the
+    remote registry. Asserts the CCS fan-out agrees 1.0 with the local
+    merge over mirrored data, a CCR follower catches up to lag 0, and a
+    partitioned skip_unavailable remote degrades to `_clusters.skipped`
+    then recovers after heal. One JSON line on stdout; exit 0/1."""
+    import tempfile
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["ES_TPU_CCR_POLL_MS"] = "0"       # deterministic pumping
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from elasticsearch_tpu.cluster_node import form_local_cluster
+
+    log("dryrun_ccs: forming two 2-node clusters...")
+    with tempfile.TemporaryDirectory() as tmp:
+        L, _, L_ch = form_local_cluster(["L-m0", "L-d0"], f"{tmp}/L")
+        F, _, _ = form_local_cluster(["F-m0", "F-d0"], f"{tmp}/F")
+        try:
+            for n in F:
+                n.remotes.register_remote("leader", L_ch, ["L-d0"],
+                                          skip_unavailable=True)
+            L[0].create_index("logs", {"settings": {
+                "index.number_of_shards": 2,
+                "index.number_of_replicas": 0}})
+            n_docs = 40
+            for i in range(n_docs):
+                L[0].index_doc("logs", f"d{i}",
+                               {"n": i, "body": f"doc {i} common"})
+            L[0].refresh("logs")
+            # mirror inside the querying cluster for the agreement check
+            F[0].create_index("mirror", {"settings": {
+                "index.number_of_shards": 2,
+                "index.number_of_replicas": 0}})
+            for i in range(n_docs):
+                F[0].index_doc("mirror", f"d{i}",
+                               {"n": i, "body": f"doc {i} common"})
+            F[0].refresh("mirror")
+            body = {"query": {"match": {"body": "common"}}, "size": n_docs}
+            log("dryrun_ccs: fan-out vs local merge...")
+            ccs = F[0].search("leader:logs", dict(body))
+            loc = F[0].search("mirror", dict(body))
+
+            def key(r):
+                return [(h["_id"], round(h.get("_score") or 0.0, 6))
+                        for h in r["hits"]["hits"]]
+
+            agree = sum(a == b for a, b in zip(key(ccs), key(loc)))
+            agreement = agree / max(1, len(key(loc)))
+            log("dryrun_ccs: following leader:logs...")
+            F[0].ccr.follow("copy", "leader", "logs")
+            shipped = 0
+            while True:
+                moved = F[0].ccr.poll_once()
+                shipped += moved
+                if moved == 0:
+                    break
+            st = F[0].ccr.follower_stats("copy")["indices"][0]
+            lag = max(s["lag_ops"] for s in st["shards"])
+            log("dryrun_ccs: partitioning the leader cluster...")
+            L_ch.kill("L-d0")
+            part = F[0].search("leader:logs,mirror", dict(body))
+            skipped = part["_clusters"]["skipped"]
+            partial_hits = part["hits"]["total"]["value"]
+            L_ch.revive("L-d0")
+            healed = F[0].search("leader:logs,mirror", dict(body))
+            recovered = healed["_clusters"]["successful"]
+            healed_hits = healed["hits"]["total"]["value"]
+        finally:
+            for n in L + F:
+                n.close()
+    ok = (agreement == 1.0 and shipped == n_docs and lag == 0
+          and skipped == 1 and partial_hits == n_docs
+          and recovered == 2 and healed_hits == 2 * n_docs)
+    print(json.dumps({
+        "metric": "dryrun_ccs",
+        "ok": bool(ok),
+        "fanout_agreement": float(agreement),
+        "ccr_ops_shipped": int(shipped),
+        "ccr_lag_ops": int(lag),
+        "partition_skipped_clusters": int(skipped),
+        "partition_hits": int(partial_hits),
+        "healed_successful_clusters": int(recovered),
+        "healed_hits": int(healed_hits),
+    }), flush=True)
+    log(f"dryrun_ccs: agreement={agreement} shipped={shipped} lag={lag} "
+        f"skipped={skipped} recovered={recovered}")
+    return 0 if ok else 1
+
+
 def dryrun_trace() -> int:
     """Flight-recorder smoke (PR 9): single-node CPU run asserting the
     observability loop end to end — a profiled search returns a
@@ -2476,6 +2567,9 @@ if __name__ == "__main__":
     if "dryrun_chaos" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_chaos":
         sys.exit(dryrun_chaos())
+    if "dryrun_ccs" in sys.argv[1:] or \
+            os.environ.get("BENCH_MODE") == "dryrun_ccs":
+        sys.exit(dryrun_ccs())
     if "dryrun_trace" in sys.argv[1:] or \
             os.environ.get("BENCH_MODE") == "dryrun_trace":
         sys.exit(dryrun_trace())

@@ -43,6 +43,7 @@ from elasticsearch_tpu.common.errors import (
     CircuitBreakingError, ElasticsearchTpuError, IndexNotFoundError,
     SearchPhaseExecutionError,
 )
+from elasticsearch_tpu.cluster.remote import ACTION_REMOTE_SEARCH
 from elasticsearch_tpu.cluster.state import ClusterState
 from elasticsearch_tpu.common import metrics, tracing
 from elasticsearch_tpu.common.settings import knob
@@ -269,7 +270,7 @@ class SearchActionService:
 
     def __init__(self, transport: TransportService, channels: NodeChannels,
                  shard_service: DistributedShardService, breakers=None,
-                 thread_pool=None, tasks=None, overload=None):
+                 thread_pool=None, tasks=None, overload=None, remotes=None):
         from elasticsearch_tpu.common.breaker import (
             HierarchyCircuitBreakerService,
         )
@@ -299,6 +300,12 @@ class SearchActionService:
         transport.register_request_handler(ACTION_FREE, self._on_free_context)
         transport.register_request_handler(ACTION_CAN_MATCH,
                                            self._on_can_match)
+        # cross-cluster plane (PR 20): the registry of named remote
+        # clusters this coordinator may fan out to, and the handler that
+        # answers a REMOTE coordinator's one-RPC-per-cluster search leg
+        self.remotes = remotes
+        transport.register_request_handler(ACTION_REMOTE_SEARCH,
+                                           self._on_remote_search)
         # adaptive replica selection state: EWMA of per-node shard-query
         # service time (ref: OperationRouting.java:34 rankShardsAndUpdateStats
         # / ResponseCollectorService)
@@ -534,6 +541,22 @@ class SearchActionService:
                        for v in searcher.views):
                 return {"can_match": False}
         return {"can_match": True}
+
+    def _on_remote_search(self, req) -> dict:
+        """Answer a REMOTE coordinator's cross-cluster search leg (PR 20):
+        one RPC per remote cluster (ref: ccs_minimize_roundtrips) — this
+        node runs the full local query-then-fetch for the pattern and
+        returns the merged per-cluster response. `_trace`/`_sla` crossed
+        the cluster boundary in the payload, so the leg's spans parent
+        into the caller's trace and its shard dispatches keep the
+        caller's SLA tier."""
+        p = req.payload
+        tc = tracing.child_from_wire(p.get("_trace"),
+                                     node=self.shards.node_name,
+                                     kind="remote_search")
+        with tracing.activate(tc), scheduler.activate_tier(p.get("_sla")):
+            return self.execute_search(p.get("index") or "_all",
+                                       dict(p.get("body") or {}))
 
     @staticmethod
     def _required_terms(body: dict) -> List[Tuple[str, str]]:
@@ -878,6 +901,18 @@ class SearchActionService:
         from elasticsearch_tpu.tasks.task_manager import (
             Deadline, parse_timeout_ms,
         )
+
+        # cross-cluster fan-out (PR 20): `remote:pattern` parts split off
+        # into one search RPC per remote cluster; the purely-local leg
+        # re-enters here under the same task/trace/tier
+        if self.remotes is not None \
+                and self.remotes.has_remote_parts(index_expr):
+            local_parts, remote_groups = \
+                self.remotes.split_expression(index_expr)
+            return self.remotes.cross_cluster_search(
+                body, local_parts, remote_groups,
+                lambda expr, sub: self._execute_search_phases(
+                    expr, sub, state))
 
         start = time.monotonic()
         state = state or self.shards.state

@@ -124,10 +124,21 @@ class ClusterNode:
         self.integrity_scrubber.start()
         self.applier = IndicesClusterStateService(
             node_name, self.shard_service, self.master_client)
+        from elasticsearch_tpu.cluster.remote import RemoteClusterService
+
+        # cross-cluster plane (PR 20): named remote clusters this node can
+        # fan searches out to / pull CCR ops from; the search action gets
+        # the registry so `remote:index` patterns split at its front door
+        self.remotes = RemoteClusterService(node_name,
+                                            overload=self.overload)
         self.search_action = SearchActionService(
             self.transport, channels, self.shard_service,
             thread_pool=self.thread_pool, tasks=self.tasks,
-            overload=self.overload)
+            overload=self.overload, remotes=self.remotes)
+        from elasticsearch_tpu.index.ccr import CcrService, ClusterNodeHost
+
+        self.ccr = CcrService(ClusterNodeHost(self), self.remotes,
+                              self.transport)
         t = self.transport
         t.register_request_handler("indices:admin/create",
                                    self._on_create_index)
@@ -692,6 +703,7 @@ class ClusterNode:
                                   {"commands": commands, "dry_run": dry_run})
 
     def close(self) -> None:
+        self.ccr.stop()
         self.integrity_scrubber.stop()
         for t in self._delayed_timers:
             t.cancel()

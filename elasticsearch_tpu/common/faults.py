@@ -60,6 +60,11 @@ TRANSPORT_SITES = frozenset({
     "rpc_recovery",      # target -> source peer-recovery RPCs (all phases)
     "rpc_resync",        # new primary -> replica resync RPCs
     "rpc_relocation",    # relocation target -> source warm-handoff RPC
+    # cross-cluster sites (PR 20): `#part` selects the remote CLUSTER
+    # alias, not a node — the remote service fires them once per attempt
+    # before dispatching into the remote cluster's channels
+    "rpc_remote_search",  # CCS coordinator -> remote cluster search RPC
+    "rpc_ccr_fetch",      # CCR follower -> leader cluster RPCs (info+ops)
 })
 
 # Durable-storage sites (translog / segment commit): failures here must

@@ -290,6 +290,31 @@ declare_counter("knn_uncertified",
                 "queries whose int8 superset certificate failed and were "
                 "re-served through the exact f32 first pass")
 
+# cross-cluster plane (PR 20): CCS counters bumped by cluster/remote.py
+# (the `tpu_ccs` section of GET /_nodes/stats), CCR counters by
+# index/ccr.py (the `tpu_ccr` section)
+declare_counter("ccs_remote_searches",
+                "cross-cluster search fan-out legs dispatched to remotes")
+declare_counter("ccs_skipped_clusters",
+                "remote clusters degraded to _clusters.skipped "
+                "(unreachable with skip_unavailable=true)")
+declare_counter("ccs_remote_failures",
+                "remote-cluster RPC attempts that failed (transport "
+                "error or timeout; retries count separately)")
+declare_counter("ccs_remote_retries",
+                "remote-cluster RPC retries granted by the retry budget")
+declare_counter("ccr_ops_shipped",
+                "translog ops applied onto follower indices (cumulative)")
+declare_counter("ccr_fetches",
+                "CCR fetch_ops batches pulled from leader clusters")
+declare_counter("ccr_fetch_retries",
+                "CCR fetches re-issued after a failed or corrupt batch")
+declare_counter("ccr_checksum_mismatches",
+                "CCR op batches whose sha256 failed verification on the "
+                "follower (re-fetched, bounded by ES_TPU_REMOTE_RETRIES)")
+declare_counter("ccr_polls",
+                "follower pull-loop poll rounds executed")
+
 
 # --- Prometheus text exposition ----------------------------------------------
 

@@ -501,6 +501,20 @@ declare_knob("ES_TPU_KNN_RESCORE_MULT", "int", 4,
 declare_knob("ES_TPU_FORCE_KNN", "flag", False,
              "'1' forces KnnEngine serving eligibility off-TPU "
              "(interpret-mode differential tests)")
+# cross-cluster plane (PR 20)
+declare_knob("ES_TPU_REMOTE_RETRIES", "int", 1,
+             "Extra attempts per remote-cluster RPC after the first "
+             "(rotating across the remote's seed nodes), each spending a "
+             "token from the PR-13 retry budget")
+declare_knob("ES_TPU_REMOTE_BACKOFF_MS", "int", 25,
+             "Delay between remote-cluster RPC attempts, ms")
+declare_knob("ES_TPU_CCR_POLL_MS", "int", 100,
+             "Follower-index pull-loop poll interval, ms (0 = no "
+             "background thread; tests and bench pump poll_once() "
+             "deterministically)")
+declare_knob("ES_TPU_CCR_BATCH_OPS", "int", 512,
+             "Max translog ops per CCR fetch batch (one sha256-verified "
+             "wire payload)")
 
 
 class ClusterSettings:

@@ -141,6 +141,16 @@ class Node:
         self.integrity_scrubber = IntegrityScrubber(
             thread_pool=self.thread_pool, overload=self.overload)
         self.integrity_scrubber.start()
+        from elasticsearch_tpu.cluster.remote import RemoteClusterService
+        from elasticsearch_tpu.index.ccr import CcrService, StandaloneNodeHost
+
+        # cross-cluster plane (PR 20): remote registry + CCR pull loop;
+        # the REST layer routes `remote:index` searches and /_ccr calls
+        # through these
+        self.remotes = RemoteClusterService(node_name,
+                                            overload=self.overload)
+        self.ccr = CcrService(StandaloneNodeHost(self), self.remotes,
+                              self.transport)
         self._register_actions()
 
     # ---- cluster-state updates (single-threaded master semantics,
@@ -194,8 +204,34 @@ class Node:
         t.register_request_handler(
             "indices:admin/refresh",
             lambda req: (self.indices.get(req.payload["index"]).refresh(), {"ok": True})[1])
+        from elasticsearch_tpu.cluster.remote import ACTION_REMOTE_SEARCH
+
+        t.register_request_handler(ACTION_REMOTE_SEARCH,
+                                   self._on_remote_search)
+
+    def _on_remote_search(self, req) -> dict:
+        """Answer a remote coordinator's cross-cluster search leg (PR 20):
+        resolve the pattern locally, search each matching index, merge to
+        one well-formed response under the caller's trace/SLA context."""
+        from elasticsearch_tpu.cluster.remote import merge_leg_responses
+        from elasticsearch_tpu.common import tracing
+        from elasticsearch_tpu.threadpool import scheduler
+
+        p = req.payload
+        body = dict(p.get("body") or {})
+        tc = tracing.child_from_wire(p.get("_trace"), node=self.node_name,
+                                     kind="remote_search")
+        with tracing.activate(tc), scheduler.activate_tier(p.get("_sla")):
+            names = self.cluster_state.resolve_indices(
+                p.get("index") or "_all")
+            legs = [(None, self.indices.get(n).search(dict(body)))
+                    for n in names]
+            return merge_leg_responses(
+                legs, from_=0, size=int(body.get("size", 10) or 10),
+                sort_spec=body.get("sort"))
 
     def close(self) -> None:
+        self.ccr.stop()
         self.integrity_scrubber.stop()
         self.indices.close()
         self.transport.close()

@@ -178,6 +178,13 @@ def register_handlers(node: Node, rc: RestController) -> None:
     r("GET", "/_nodes", h.nodes_info)
     r("GET", "/_nodes/stats", h.nodes_stats)
     r("GET", "/_nodes/hot_threads", h.hot_threads)
+    # cross-cluster plane (PR 20)
+    r("GET", "/_remote/info", h.remote_info)
+    r("PUT", "/{index}/_ccr/follow", h.ccr_follow)
+    r("POST", "/{index}/_ccr/follow", h.ccr_follow)
+    r("POST", "/{index}/_ccr/pause_follow", h.ccr_pause_follow)
+    r("POST", "/{index}/_ccr/resume_follow", h.ccr_resume_follow)
+    r("GET", "/{index}/_ccr/stats", h.ccr_stats)
     # search flight recorder (PR 9)
     r("GET", "/_tpu/slowlog", h.tpu_slowlog)
     r("GET", "/_tpu/trace", h.tpu_traces)
@@ -1095,7 +1102,13 @@ class _Handlers:
                                   task=task)
             resp["pit_id"] = pit["id"]
             return self._ok_search(req, resp)
-        names = self._resolve(req.param("index"), require=True)
+        # cross-cluster fan-out (PR 20): `remote:index` parts peel off into
+        # one search RPC per registered remote; stays off the hot path for
+        # expressions with no ':' or an empty remote registry
+        index_expr = req.param("index")
+        if self.node.remotes.has_remote_parts(index_expr):
+            return self._ok_search(req, self._ccs_search(index_expr, body))
+        names = self._resolve(index_expr, require=True)
         search_type = req.param("search_type", "query_then_fetch")
         # every search runs under a registered cancellable task
         # (ref: tasks/TaskManager.java:71 via TransportAction.execute)
@@ -1870,6 +1883,26 @@ class _Handlers:
                      "hits": all_hits[from_: from_ + size]},
         }
 
+    def _ccs_search(self, index_expr: str, body: dict) -> dict:
+        """Cross-cluster fan-out for the standalone node (PR 20): peel the
+        `remote:pattern` parts off the expression and let the remote
+        registry run one leg per cluster; the purely-local parts re-enter
+        the ordinary single-/multi-index path as the local leg."""
+        local_parts, remote_groups = \
+            self.node.remotes.split_expression(index_expr)
+
+        def local_search(expr: str, sub: dict) -> dict:
+            names = self._resolve(expr, require=True)
+            if len(names) == 1:
+                return self.node.indices.get(names[0]).search(dict(sub))
+            return self._multi_index_search(names, dict(sub),
+                                            "query_then_fetch")
+
+        with self.node.tasks.task("indices:data/read/search",
+                                  f"ccs[{index_expr}]"):
+            return self.node.remotes.cross_cluster_search(
+                body, local_parts, remote_groups, local_search)
+
     def msearch(self, req: RestRequest) -> RestResponse:
         from elasticsearch_tpu.threadpool import (
             activate_tier, tier_for_request,
@@ -1885,12 +1918,21 @@ class _Handlers:
     def _msearch_inner(self, req: RestRequest) -> RestResponse:
         lines = [ln for ln in req.raw_body.decode().split("\n") if ln.strip()]
         slots = []   # (index_names | None, body, error | None)
+        ccs_exprs: dict = {}   # slot -> `remote:pattern` expression (PR 20)
         i = 0
         while i + 1 <= len(lines) - 1 or (i < len(lines)):
             header = json.loads(lines[i])
             body = json.loads(lines[i + 1]) if i + 1 < len(lines) else {}
             i += 2
             index = header.get("index", req.param("index", "_all"))
+            # a `remote:index` line fans out per cluster instead of
+            # resolving locally — a line targeting only dead
+            # skip_unavailable remotes must come back empty-but-well-formed
+            # (`_clusters.skipped` counted), never as an error entry
+            if self.node.remotes.has_remote_parts(index):
+                ccs_exprs[len(slots)] = index
+                slots.append((None, body, None))
+                continue
             try:
                 slots.append((self._resolve(index, require=True), body, None))
             except ElasticsearchTpuError as e:
@@ -1899,7 +1941,7 @@ class _Handlers:
         # queries share one device dispatch (ref P8 batched _msearch)
         by_index: dict = {}
         for si, (names, body, err) in enumerate(slots):
-            if err is None and len(names) == 1:
+            if err is None and names is not None and len(names) == 1:
                 by_index.setdefault(names[0], []).append(si)
         batched: dict = {}
         for name, idxs in by_index.items():
@@ -1915,7 +1957,15 @@ class _Handlers:
                     batched[si] = {"error": e.to_dict(), "status": e.status}
         responses = []
         for si, (names, body, err) in enumerate(slots):
-            if err is not None:
+            if si in ccs_exprs:
+                try:
+                    responses.append({**self._ccs_search(ccs_exprs[si],
+                                                         body),
+                                      "status": 200})
+                except ElasticsearchTpuError as e:
+                    responses.append({"error": e.to_dict(),
+                                      "status": e.status})
+            elif err is not None:
                 responses.append({"error": err.to_dict(), "status": err.status})
             elif si in batched:
                 responses.append(batched[si])
@@ -2103,6 +2153,34 @@ class _Handlers:
             } for nid, n in cs.nodes.items()},
         })
 
+    # ---- cross-cluster plane (PR 20) ----
+
+    def remote_info(self, req: RestRequest) -> RestResponse:
+        """GET /_remote/info (ref: RestRemoteClusterInfoAction)."""
+        return _ok(self.node.remotes.remote_info())
+
+    def ccr_follow(self, req: RestRequest) -> RestResponse:
+        """PUT /{index}/_ccr/follow (ref: RestPutFollowAction)."""
+        body = dict(req.body or {})
+        remote_cluster = body.get("remote_cluster")
+        leader_index = body.get("leader_index")
+        if not remote_cluster or not leader_index:
+            raise IllegalArgumentError(
+                "_ccr/follow requires [remote_cluster] and [leader_index]")
+        return _ok(self.node.ccr.follow(
+            req.param("index"), remote_cluster, leader_index,
+            settings=body.get("settings")))
+
+    def ccr_pause_follow(self, req: RestRequest) -> RestResponse:
+        return _ok(self.node.ccr.pause_follow(req.param("index")))
+
+    def ccr_resume_follow(self, req: RestRequest) -> RestResponse:
+        return _ok(self.node.ccr.resume_follow(req.param("index")))
+
+    def ccr_stats(self, req: RestRequest) -> RestResponse:
+        """GET /{index}/_ccr/stats (ref: RestFollowStatsAction)."""
+        return _ok(self.node.ccr.follower_stats(req.param("index")))
+
     def _local_node_stats(self) -> dict:
         """This node's full stats sections — the REST body for a
         single-node cluster and the telemetry plane's RPC answer when a
@@ -2130,6 +2208,8 @@ class _Handlers:
             "tpu_overload": self.node.overload.stats(),
             "tpu_relocation": _tpu_relocation_stats(),
             "tpu_integrity": _tpu_integrity_stats(),
+            "tpu_ccs": self.node.remotes.stats(),
+            "tpu_ccr": self.node.ccr.stats(),
             "jvm": {"uptime_in_millis": int((time.time() - _START_TIME) * 1000)},
         }
 

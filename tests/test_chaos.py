@@ -378,3 +378,84 @@ def test_disk_corruption_promotes_replica_and_heals_copy(tmp_path):
     acked_bulk(cluster, history, [write_op(d, 2) for d in docs[:4]])
     final_reads(cluster, history, docs)
     assert history.check() == []
+
+
+def test_leader_cluster_crash_restart_mid_replication(tmp_path, monkeypatch):
+    """Scenario 12 (cross-cluster plane, PR 20): the LEADER cluster
+    crash-restarts mid-replication while the follower cluster keeps
+    serving reads from what it already pulled. Invariants: zero acked
+    leader writes lost (history linearizable including post-convergence
+    follower reads), mid-outage follower reads are exactly the pre-crash
+    snapshot, and after heal the follower converges to the leader's
+    global checkpoint."""
+    monkeypatch.setenv("ES_TPU_CCR_POLL_MS", "0")        # manual pump
+    monkeypatch.setenv("ES_TPU_REMOTE_BACKOFF_MS", "0")
+    leader = CrashRestartCluster(
+        ["L-m0", "L-d0", "L-d1"], str(tmp_path / "L"),
+        roles={"L-m0": ("master",)})
+    follower = CrashRestartCluster(
+        ["F-m0", "F-d0"], str(tmp_path / "F"),
+        roles={"F-m0": ("master",)})
+    leader.master().create_index("docs", {
+        "settings": {"number_of_shards": 1, "number_of_replicas": 1},
+        "mappings": MAPPINGS})
+    for n in follower.nodes:
+        n.remotes.register_remote("leader", leader.channels,
+                                  ["L-d0", "L-d1"], skip_unavailable=True)
+    history = AckedWriteHistory()
+    docs = [f"doc{i}" for i in range(8)]
+
+    def pump():
+        total = 0
+        for n in follower.nodes:
+            while True:
+                moved = n.ccr.poll_once()
+                total += moved
+                if moved == 0:
+                    break
+        return total
+
+    # phase 1: writes replicate to the follower, which serves them
+    acked_bulk(leader, history, [write_op(d, 1) for d in docs])
+    follower.master().ccr.follow("docs_copy", "leader", "docs")
+    assert pump() == len(docs)
+    snapshot = {d: follower.read_doc("docs_copy", d)["n"] for d in docs}
+    assert set(snapshot.values()) == {1}
+
+    # phase 2: more acked writes land on the leader, and BEFORE the
+    # follower pulls them the whole leader data plane crashes
+    acked_bulk(leader, history, [write_op(d, 2) for d in docs[:5]])
+    leader.primary_instance("docs", docs[0]).engine.flush()
+    leader.crash("L-d0", report=False)
+    leader.crash("L-d1", report=False)
+
+    # the follower keeps serving its pre-crash snapshot; the pull loop
+    # records the outage and keeps the loop alive — never raises
+    assert pump() == 0
+    for d in docs:
+        assert follower.read_doc("docs_copy", d)["n"] == snapshot[d]
+    st = follower.master().ccr.follower_stats("docs_copy")["indices"][0]
+    assert "last_error" in st
+
+    # heal: the leader restarts from disk (commit load + translog replay
+    # restores every acked write), takes more writes, and the follower
+    # catches all the way up to the leader's global checkpoint
+    leader.restart("L-d0")
+    leader.restart("L-d1")
+    acked_bulk(leader, history, [write_op(d, 3) for d in docs[:2]])
+    assert pump() > 0
+    assert pump() == 0                       # converged: nothing left
+    f_inst = follower.primary_instance("docs_copy", docs[0])
+    l_inst = leader.primary_instance("docs", docs[0])
+    assert f_inst.engine.local_checkpoint \
+        == l_inst.tracker.global_checkpoint
+    st = follower.master().ccr.follower_stats("docs_copy")["indices"][0]
+    assert all(s["lag_ops"] == 0 for s in st["shards"])
+
+    # the acked-write history — leader final reads AND post-convergence
+    # follower reads — is linearizable: nothing acked was lost anywhere
+    final_reads(leader, history, docs)
+    for d in sorted(docs):
+        src = follower.read_doc("docs_copy", d)
+        history.record_read(d, None if src is None else src["n"])
+    assert history.check() == []
